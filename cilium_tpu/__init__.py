@@ -1,0 +1,29 @@
+"""cilium-tpu: a TPU-native network-policy enforcement framework.
+
+A ground-up re-design of the capabilities of Cilium (reference:
+kiranbond/cilium) for TPU hardware: identity-based L3/L4 security policy,
+CIDR/LPM and entity rules, L7 policy (HTTP/Kafka/DNS-FQDN + pluggable
+parsers), service load-balancing and conntrack semantics, a distributed
+identity/ipcache control plane, and full observability.
+
+Instead of per-packet eBPF map lookups (reference: bpf/lib/policy.h) the
+core is a *batched* packet-classification engine: policy rules compile into
+dense tensors — exact-match hash tables, LPM structures, and DFA transition
+tables for L7 regexes — evaluated by JAX/Pallas kernels under jit/shard_map.
+
+Layout:
+    labels, identity      — label & security-identity model (pure host)
+    policy/               — rule schema, repository, resolution (pure host)
+    compiler/             — resolved policy -> dense tensor artifacts
+    ops/                  — JAX/Pallas kernels (hash lookup, LPM, DFA)
+    datapath/             — the batched datapath: verdict, conntrack, LB
+    parallel/             — mesh / sharding helpers (ICI-aware layouts)
+    l7/                   — L7 engines: HTTP, Kafka, DNS, parser plugins
+    kvstore/              — distributed control-plane backend + allocator
+    agent/                — endpoint lifecycle, regeneration pipeline
+    api/                  — REST-style API surface + CLI
+    monitor/              — event stream, metrics, tracing
+    utils/                — controllers, triggers, completion, backoff
+"""
+
+__version__ = "0.1.0"

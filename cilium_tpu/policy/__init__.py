@@ -1,0 +1,10 @@
+"""Policy engine: rule schema (api), repository, L4/L3 resolution, tracing.
+
+Pure-host computation — no JAX here. The output of this layer (resolved
+``L4Policy`` / ``CIDRPolicy`` / ``PolicyMapState``) is what
+``cilium_tpu.compiler`` lowers to dense device tensors.
+"""
+
+from . import api
+from .repository import Repository
+from .trace import SearchContext, TraceEnabled, TraceDisabled

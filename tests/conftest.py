@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+
+Real TPU hardware has a single chip in this environment; multi-chip code
+paths are validated on a virtual CPU mesh exactly like the driver's
+dryrun_multichip harness.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

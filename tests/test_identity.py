@@ -1,0 +1,89 @@
+"""Identity model/allocator tests (mirrors reference pkg/identity tests)."""
+
+import pytest
+
+from cilium_tpu import identity as idpkg
+from cilium_tpu.identity import (IdentityCache, LocalIdentityAllocator,
+                                 MINIMAL_NUMERIC_IDENTITY, RESERVED_HOST,
+                                 RESERVED_WORLD, get_reserved_id,
+                                 is_reserved_identity,
+                                 look_up_reserved_identity)
+from cilium_tpu.labels import Labels
+
+
+def test_reserved_numbering():
+    # reference: pkg/identity/numericidentity.go:42-60
+    assert RESERVED_HOST == 1
+    assert RESERVED_WORLD == 2
+    assert idpkg.RESERVED_UNMANAGED == 3
+    assert idpkg.RESERVED_HEALTH == 4
+    assert idpkg.RESERVED_INIT == 5
+    assert get_reserved_id("host") == 1
+    assert get_reserved_id("world") == 2
+    assert get_reserved_id("nonexistent") == 0
+
+
+def test_reserved_identity_lookup():
+    ident = look_up_reserved_identity(RESERVED_HOST)
+    assert ident is not None
+    assert ident.label_array.has("reserved.host")
+
+
+def test_is_reserved():
+    assert is_reserved_identity(1)
+    assert is_reserved_identity(255)
+    assert not is_reserved_identity(0)
+    assert not is_reserved_identity(256)
+
+
+def test_allocate_same_labels_same_id():
+    a = LocalIdentityAllocator()
+    l1 = Labels.from_model(["k8s:app=foo", "k8s:env=prod"])
+    l2 = Labels.from_model(["k8s:env=prod", "k8s:app=foo"])
+    id1, new1 = a.allocate(l1)
+    id2, new2 = a.allocate(l2)
+    assert new1 and not new2
+    assert id1.id == id2.id
+    assert id1.id >= MINIMAL_NUMERIC_IDENTITY
+
+
+def test_allocate_different_labels_different_id():
+    a = LocalIdentityAllocator()
+    id1, _ = a.allocate(Labels.from_model(["k8s:app=foo"]))
+    id2, _ = a.allocate(Labels.from_model(["k8s:app=bar"]))
+    assert id1.id != id2.id
+
+
+def test_release_refcount():
+    a = LocalIdentityAllocator()
+    labels = Labels.from_model(["k8s:app=foo"])
+    ident, _ = a.allocate(labels)
+    a.allocate(labels)  # refcount 2
+    assert not a.release(ident)  # still referenced
+    assert a.lookup_by_id(ident.id) is not None
+    assert a.release(ident)  # freed
+    assert a.lookup_by_id(ident.id) is None
+
+
+def test_reserved_labels_shortcircuit():
+    a = LocalIdentityAllocator()
+    ident, new = a.allocate(Labels.from_model(["reserved:host"]))
+    assert ident.id == RESERVED_HOST
+    assert not new
+
+
+def test_cluster_id_bits():
+    # reference: identity/allocator.go:93 — cluster ID above bit 16
+    a = LocalIdentityAllocator(cluster_id=3)
+    ident, _ = a.allocate(Labels.from_model(["k8s:app=foo"]))
+    assert ident.id >> 16 == 3
+    assert ident.id & 0xFFFF >= MINIMAL_NUMERIC_IDENTITY
+
+
+def test_identity_cache_snapshot():
+    a = LocalIdentityAllocator()
+    ident, _ = a.allocate(Labels.from_model(["k8s:app=foo"]))
+    cache = IdentityCache.snapshot(a)
+    assert ident.id in cache
+    assert 1 in cache  # reserved host present
+    assert cache[ident.id].has("k8s.app")

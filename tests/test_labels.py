@@ -1,0 +1,103 @@
+"""Label model tests (mirrors reference pkg/labels/labels_test.go)."""
+
+from cilium_tpu import labels as lbl
+from cilium_tpu.labels import (Label, LabelArray, Labels, get_cidr_labels,
+                               ip_to_cidr_label, parse_label,
+                               parse_select_label)
+
+
+def test_parse_label_basic():
+    l = parse_label("k8s:io.kubernetes.pod.namespace=default")
+    assert l.source == "k8s"
+    assert l.key == "io.kubernetes.pod.namespace"
+    assert l.value == "default"
+
+
+def test_parse_label_no_source():
+    l = parse_label("foo=bar")
+    assert l.source == lbl.SOURCE_UNSPEC
+    assert l.key == "foo"
+    assert l.value == "bar"
+
+
+def test_parse_label_no_value():
+    l = parse_label("container:id.service1")
+    assert l.source == "container"
+    assert l.key == "id.service1"
+    assert l.value == ""
+
+
+def test_parse_label_reserved_shorthand():
+    l = parse_label("$host")
+    assert l.source == lbl.SOURCE_RESERVED
+    assert l.key == "host"
+
+
+def test_parse_label_equals_before_colon():
+    # '=' before ':' means the whole string before '=' is the key.
+    l = parse_label("key=value:with-colon")
+    assert l.source == lbl.SOURCE_UNSPEC
+    assert l.key == "key"
+    assert l.value == "value:with-colon"
+
+
+def test_parse_select_label_promotes_any():
+    l = parse_select_label("foo")
+    assert l.source == lbl.SOURCE_ANY
+    l2 = parse_select_label("k8s:foo")
+    assert l2.source == "k8s"
+
+
+def test_extended_key():
+    assert parse_label("k8s:foo=bar").extended_key == "k8s.foo"
+    assert parse_label("foo").extended_key == "any.foo"
+    assert parse_select_label("foo").extended_key == "any.foo"
+
+
+def test_label_array_has_any_wildcard():
+    arr = LabelArray.parse("k8s:foo=bar", "container:svc=a")
+    assert arr.has("any.foo")
+    assert arr.has("k8s.foo")
+    assert not arr.has("container.foo")
+    assert arr.get("any.svc") == "a"
+
+
+def test_labels_sorted_list_deterministic():
+    a = Labels.from_model(["k8s:a=1", "container:b=2", "z=3"])
+    b = Labels.from_model(["z=3", "k8s:a=1", "container:b=2"])
+    assert a.sorted_list() == b.sorted_list()
+    assert a.sha256_sum() == b.sha256_sum()
+
+
+def test_labels_sha_differs():
+    a = Labels.from_model(["k8s:a=1"])
+    b = Labels.from_model(["k8s:a=2"])
+    assert a.sha256_sum() != b.sha256_sum()
+
+
+def test_label_array_contains():
+    arr = LabelArray.parse("tag1", "tag2")
+    assert arr.contains(LabelArray.parse("tag1"))
+    assert arr.contains(LabelArray.parse("tag1", "tag2"))
+    assert not arr.contains(LabelArray.parse("tag3"))
+    assert arr.contains(LabelArray())  # empty needed -> True
+
+
+def test_cidr_labels_expand_all_prefixes():
+    arr = get_cidr_labels("10.1.1.0/24")
+    keys = [l.key for l in arr if l.source == lbl.SOURCE_CIDR]
+    assert len(keys) == 25  # /0 .. /24
+    assert "10-1-1-0-24" in keys
+    assert "0-0-0-0-0" in keys
+    # world label included
+    assert any(l.source == lbl.SOURCE_RESERVED and l.key == "world"
+               for l in arr)
+
+
+def test_cidr_label_matching_covering_prefix():
+    # An IP's expanded labels include every covering prefix, so a policy
+    # selector over a broader CIDR label matches the narrower identity.
+    ip_labels = get_cidr_labels("10.1.1.7/32")
+    want = ip_to_cidr_label("10.1.0.0/16")
+    assert any(l.key == want.key and l.source == want.source
+               for l in ip_labels)

@@ -1,0 +1,302 @@
+"""Rule schema / selector / validation tests — mirrors reference
+pkg/policy/api (selector_test.go, rule_validation_test.go, cidr_test.go,
+entity_test.go) matrices.
+"""
+
+import pytest
+
+from cilium_tpu.labels import LabelArray, parse_select_label
+from cilium_tpu.policy import api
+from cilium_tpu.policy.api import (CIDRRule, EgressRule, EndpointSelector,
+                                   FQDNSelector, IngressRule, L7Rules,
+                                   Operator, PolicyError, PortProtocol,
+                                   PortRule, PortRuleHTTP, PortRuleKafka,
+                                   Requirement, Rule, Service,
+                                   compute_resultant_cidr_set, remove_cidrs)
+
+
+def arr(*labels):
+    return LabelArray.parse_select(*labels)
+
+
+# --- selectors --------------------------------------------------------------
+
+def test_selector_matches_basic():
+    sel = EndpointSelector.parse("foo")
+    assert sel.matches(arr("foo"))
+    assert sel.matches(arr("foo", "bar"))
+    assert not sel.matches(arr("bar"))
+
+
+def test_selector_any_source_matches_all_sources():
+    sel = EndpointSelector.parse("foo")
+    assert sel.matches(LabelArray.parse("k8s:foo"))
+    assert sel.matches(LabelArray.parse("container:foo"))
+
+
+def test_selector_specific_source():
+    sel = EndpointSelector.parse("k8s:foo")
+    assert sel.matches(LabelArray.parse("k8s:foo"))
+    assert not sel.matches(LabelArray.parse("container:foo"))
+
+
+def test_selector_value_match():
+    sel = EndpointSelector.parse("k8s:app=web")
+    assert sel.matches(LabelArray.parse("k8s:app=web"))
+    assert not sel.matches(LabelArray.parse("k8s:app=db"))
+
+
+def test_wildcard_selector():
+    assert api.WILDCARD_SELECTOR.matches(arr())
+    assert api.WILDCARD_SELECTOR.matches(arr("anything"))
+    assert api.WILDCARD_SELECTOR.is_wildcard()
+
+
+def test_selector_match_expressions():
+    sel = EndpointSelector(
+        match_expressions=[Requirement(key="env", operator=Operator.IN,
+                                       values=("prod", "staging"))])
+    assert sel.matches(LabelArray.parse("k8s:env=prod"))
+    assert not sel.matches(LabelArray.parse("k8s:env=dev"))
+
+    sel = EndpointSelector(
+        match_expressions=[Requirement(key="env",
+                                       operator=Operator.NOT_IN,
+                                       values=("prod",))])
+    assert sel.matches(LabelArray.parse("k8s:env=dev"))
+    assert sel.matches(arr("other"))  # absent key matches NotIn
+    assert not sel.matches(LabelArray.parse("k8s:env=prod"))
+
+    sel = EndpointSelector(
+        match_expressions=[Requirement(key="env", operator=Operator.EXISTS)])
+    assert sel.matches(LabelArray.parse("k8s:env=prod"))
+    assert not sel.matches(arr("other"))
+
+    sel = EndpointSelector(
+        match_expressions=[Requirement(key="env",
+                                       operator=Operator.DOES_NOT_EXIST)])
+    assert not sel.matches(LabelArray.parse("k8s:env=prod"))
+    assert sel.matches(arr("other"))
+
+
+def test_selector_requires_values_for_in():
+    sel = EndpointSelector(
+        match_expressions=[Requirement(key="env", operator=Operator.IN)])
+    with pytest.raises(PolicyError):
+        sel.sanitize()
+
+
+def test_selector_hashable_and_eq():
+    a = EndpointSelector.parse("foo")
+    b = EndpointSelector.parse("foo")
+    c = EndpointSelector.parse("bar")
+    assert a == b and hash(a) == hash(b)
+    assert a != c
+    assert len({a, b, c}) == 2
+
+
+# --- entities ---------------------------------------------------------------
+
+def test_entity_selectors():
+    sels = api.entities_as_selectors([api.ENTITY_WORLD])
+    assert sels[0].matches(LabelArray.parse("reserved:world"))
+    sels = api.entities_as_selectors([api.ENTITY_ALL])
+    assert sels[0].is_wildcard()
+    sels = api.entities_as_selectors([api.ENTITY_HOST])
+    assert sels[0].matches(LabelArray.parse("reserved:host"))
+
+
+def test_entity_cluster_after_init():
+    api.init_entities("mycluster")
+    sels = api.entities_as_selectors([api.ENTITY_CLUSTER])
+    assert any(s.matches(LabelArray.parse("reserved:host")) for s in sels)
+    assert any(s.matches(LabelArray.parse(
+        f"k8s:{api.POLICY_LABEL_CLUSTER}=mycluster")) for s in sels)
+    api.init_entities("default")
+
+
+def test_invalid_entity_rejected():
+    rule = Rule(endpoint_selector=EndpointSelector.parse("foo"),
+                ingress=[IngressRule(from_entities=["galaxy"])])
+    with pytest.raises(PolicyError):
+        rule.sanitize()
+
+
+# --- CIDR -------------------------------------------------------------------
+
+def test_cidr_sanitize():
+    assert api.sanitize_cidr("10.0.0.0/8") == 8
+    with pytest.raises(PolicyError):
+        api.sanitize_cidr("10.0.0.0/40")
+    with pytest.raises(PolicyError):
+        api.sanitize_cidr("not-a-cidr")
+
+
+def test_cidr_rule_except_must_be_contained():
+    with pytest.raises(PolicyError):
+        CIDRRule(cidr="10.0.0.0/8", except_cidrs=("192.168.0.0/16",)).sanitize()
+    assert CIDRRule(cidr="10.0.0.0/8",
+                    except_cidrs=("10.1.0.0/16",)).sanitize() == 8
+
+
+def test_remove_cidrs():
+    out = remove_cidrs(["10.0.0.0/8"], ["10.0.0.0/9"])
+    assert out == ["10.128.0.0/9"]
+    out = remove_cidrs(["10.0.0.0/8"], ["8.0.0.0/8"])
+    assert out == ["10.0.0.0/8"]
+
+
+def test_compute_resultant_cidr_set():
+    out = compute_resultant_cidr_set([
+        CIDRRule(cidr="10.0.0.0/24", except_cidrs=("10.0.0.128/25",))])
+    assert out == ["10.0.0.0/25"]
+
+
+def test_cidrs_as_selectors_world():
+    sels = api.cidrs_as_selectors(["0.0.0.0/0"])
+    assert any(s.matches(LabelArray.parse("reserved:world")) for s in sels)
+
+
+# --- ports / L7 -------------------------------------------------------------
+
+def test_port_protocol_sanitize():
+    p = PortProtocol(port="80", protocol="tcp").sanitize()
+    assert p.protocol == "TCP"
+    p = PortProtocol(port="53").sanitize()
+    assert p.protocol == "ANY"
+    with pytest.raises(PolicyError):
+        PortProtocol(port="99999", protocol="TCP").sanitize()
+    with pytest.raises(PolicyError):
+        PortProtocol(port="http", protocol="TCP").sanitize()
+    with pytest.raises(PolicyError):
+        PortProtocol(port="80", protocol="SCTP").sanitize()
+
+
+def test_max_ports():
+    pr = PortRule(ports=[PortProtocol(port=str(p), protocol="TCP")
+                         for p in range(1, 43)])
+    with pytest.raises(PolicyError):
+        pr.sanitize(ingress=True)
+
+
+def test_http_rule_regex_validation():
+    PortRuleHTTP(path="/public/.*", method="GET").sanitize()
+    with pytest.raises(PolicyError):
+        PortRuleHTTP(path="/public/(").sanitize()
+
+
+def test_http_rule_matching():
+    r = PortRuleHTTP(method="GET", path="/public/.*")
+    assert r.matches("GET", "/public/index.html")
+    assert not r.matches("POST", "/public/index.html")
+    assert not r.matches("GET", "/private/x")
+    # empty rule matches everything
+    assert PortRuleHTTP().matches("PUT", "/x")
+    # header presence + value
+    r = PortRuleHTTP(headers=("X-Token true",))
+    assert r.matches("GET", "/", headers={"x-token": "true"})
+    assert not r.matches("GET", "/", headers={})
+
+
+def test_kafka_rule_validation():
+    PortRuleKafka(api_key="produce", topic="logs").sanitize()
+    with pytest.raises(PolicyError):
+        PortRuleKafka(role="produce", api_key="fetch").sanitize()
+    with pytest.raises(PolicyError):
+        PortRuleKafka(api_key="not-a-key").sanitize()
+    with pytest.raises(PolicyError):
+        PortRuleKafka(role="observe").sanitize()
+    with pytest.raises(PolicyError):
+        PortRuleKafka(api_version="abc").sanitize()
+    with pytest.raises(PolicyError):
+        PortRuleKafka(topic="bad topic!").sanitize()
+
+
+def test_kafka_role_expansion():
+    """Reference: kafka.go:273-293 MapRoleToAPIKey."""
+    r = PortRuleKafka(role="produce")
+    assert set(r.api_keys_int) == {0, 3, 18}
+    r = PortRuleKafka(role="consume")
+    assert set(r.api_keys_int) == {1, 2, 3, 8, 9, 10, 11, 12, 13, 14, 18}
+    assert r.matches_api_key(1)
+    assert not r.matches_api_key(0)
+    # no role/key: all allowed
+    assert PortRuleKafka().matches_api_key(33)
+
+
+def test_l7_rules_union_exclusive():
+    with pytest.raises(PolicyError):
+        L7Rules(http=[PortRuleHTTP()], kafka=[PortRuleKafka()]).sanitize()
+    L7Rules(http=[PortRuleHTTP()]).sanitize()
+
+
+# --- rule-level validation --------------------------------------------------
+
+def test_l3_member_exclusivity_ingress():
+    """Reference: rule_validation_test.go / TestL3PolicyRestrictions —
+    combining FromCIDR and FromEndpoints is rejected."""
+    r = Rule(endpoint_selector=EndpointSelector.parse("foo"), ingress=[
+        IngressRule(from_cidr=["10.0.0.0/8"],
+                    from_endpoints=[EndpointSelector.parse("bar")])])
+    with pytest.raises(PolicyError):
+        r.sanitize()
+
+
+def test_from_cidr_with_ports_rejected():
+    """Ingress CIDR+L4 unsupported (l3DependentL4Support=false for FromCIDR)."""
+    r = Rule(endpoint_selector=EndpointSelector.parse("foo"), ingress=[
+        IngressRule(from_cidr=["10.0.0.0/8"],
+                    to_ports=[PortRule(ports=[
+                        PortProtocol(port="80", protocol="TCP")])])])
+    with pytest.raises(PolicyError):
+        r.sanitize()
+
+
+def test_to_cidr_with_ports_allowed():
+    """Egress CIDR+L4 is supported (l3DependentL4Support=true for ToCIDR)."""
+    r = Rule(endpoint_selector=EndpointSelector.parse("foo"), egress=[
+        EgressRule(to_cidr=["10.0.0.0/8"],
+                   to_ports=[PortRule(ports=[
+                       PortProtocol(port="80", protocol="TCP")])])])
+    r.sanitize()
+
+
+def test_egress_member_exclusivity():
+    r = Rule(endpoint_selector=EndpointSelector.parse("foo"), egress=[
+        EgressRule(to_cidr=["10.0.0.0/8"],
+                   to_services=[Service()])])
+    with pytest.raises(PolicyError):
+        r.sanitize()
+
+
+def test_too_many_prefix_lengths():
+    cidrs = [f"fd00::/{p}" for p in range(8, 50)]  # 42 distinct lengths
+    r = Rule(endpoint_selector=EndpointSelector.parse("foo"), ingress=[
+        IngressRule(from_cidr=cidrs)])
+    with pytest.raises(PolicyError):
+        r.sanitize()
+
+
+def test_cilium_generated_labels_rejected():
+    from cilium_tpu.labels import Label
+    r = Rule(endpoint_selector=EndpointSelector.parse("foo"),
+             labels=LabelArray([Label(key="x", source="cilium-generated")]))
+    with pytest.raises(PolicyError):
+        r.sanitize()
+
+
+# --- FQDN -------------------------------------------------------------------
+
+def test_fqdn_selector():
+    FQDNSelector(match_name="cilium.io").sanitize()
+    with pytest.raises(PolicyError):
+        FQDNSelector().sanitize()
+    with pytest.raises(PolicyError):
+        FQDNSelector(match_name="*.cilium.io").sanitize()
+    s = FQDNSelector(match_pattern="*.cilium.io")
+    s.sanitize()
+    assert s.matches("sub.cilium.io")
+    assert s.matches("SUB.CILIUM.IO.")
+    assert not s.matches("cilium.io")
+    assert not s.matches("sub.cilium.io.evil.com")
+    assert FQDNSelector(match_name="cilium.io").matches("cilium.io")

@@ -1,0 +1,323 @@
+"""Repository verdict tests — mirrors reference pkg/policy/repository_test.go
+(TestAddSearchDelete, TestCanReachIngress/Egress, wildcard tests) and the
+FromRequires precedence matrices.
+"""
+
+import pytest
+
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.policy.api import (CIDRRule, Decision, EgressRule,
+                                   EndpointSelector, IngressRule, L7Rules,
+                                   PolicyError, PortProtocol, PortRule,
+                                   PortRuleHTTP, PortRuleKafka, Rule)
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.trace import Port, SearchContext, traced_context
+
+
+def es(*labels):
+    return EndpointSelector.parse(*labels)
+
+
+def ctx(frm, to, dports=None):
+    return SearchContext(from_labels=LabelArray.parse_select(*frm),
+                         to_labels=LabelArray.parse_select(*to),
+                         dports=list(dports or []))
+
+
+def test_add_search_delete():
+    repo = Repository()
+    tag1 = LabelArray.parse("tag1", "tag2")
+    tag2 = LabelArray.parse("tag3")
+    rule1 = Rule(endpoint_selector=es("foo"), labels=tag1)
+    rule2 = Rule(endpoint_selector=es("bar"), labels=tag1)
+    rule3 = Rule(endpoint_selector=es("bar"), labels=tag2)
+
+    assert repo.revision == 1
+    assert repo.add(rule1) == 2
+    assert repo.add(rule2) == 3
+    assert repo.search(tag2) == []
+    assert repo.add(rule3) == 4
+    assert repo.search(tag1) == [rule1, rule2]
+    assert repo.search(tag2) == [rule3]
+
+    rev, n = repo.delete_by_labels(tag1)
+    assert (rev, n) == (5, 2)
+    rev, n = repo.delete_by_labels(tag1)
+    assert (rev, n) == (5, 0)
+    assert repo.search(tag2) == [rule3]
+    rev, n = repo.delete_by_labels(tag2)
+    assert (rev, n) == (6, 1)
+    assert repo.search(tag2) == []
+
+
+def test_empty_rule_rejected():
+    repo = Repository()
+    with pytest.raises(PolicyError):
+        repo.add(Rule(endpoint_selector=None))
+
+
+def _load_can_reach_rules(repo):
+    tag1 = LabelArray.parse("tag1")
+    repo.add(Rule(endpoint_selector=es("bar"), labels=tag1, ingress=[
+        IngressRule(from_endpoints=[es("foo")])]))
+    repo.add(Rule(endpoint_selector=es("groupA"), labels=tag1, ingress=[
+        IngressRule(from_requires=[es("groupA")])]))
+    repo.add(Rule(endpoint_selector=es("bar2"), labels=tag1, ingress=[
+        IngressRule(from_endpoints=[es("foo")])]))
+
+
+def test_can_reach_ingress_matrix():
+    """Reference: repository_test.go:193 TestCanReachIngress."""
+    repo = Repository()
+    foo_to_bar = ctx(["foo"], ["bar"])
+    assert repo.can_reach_ingress(foo_to_bar) == Decision.UNDECIDED
+    assert repo.allows_ingress(foo_to_bar) == Decision.DENIED
+
+    _load_can_reach_rules(repo)
+
+    assert repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.ALLOWED
+    assert repo.allows_ingress(ctx(["foo"], ["bar2"])) == Decision.ALLOWED
+    # foo inside groupA => OK (requirement satisfied)
+    assert repo.allows_ingress(
+        ctx(["foo", "groupA"], ["bar", "groupA"])) == Decision.ALLOWED
+    # groupB can't talk to groupA => denied by FromRequires
+    assert repo.allows_ingress(
+        ctx(["foo", "groupB"], ["bar", "groupA"])) == Decision.DENIED
+    # no restriction on groupB
+    assert repo.allows_ingress(
+        ctx(["foo", "groupB"], ["bar", "groupB"])) == Decision.ALLOWED
+    # no rule for bar3
+    assert repo.allows_ingress(ctx(["foo"], ["bar3"])) == Decision.DENIED
+
+
+def test_can_reach_egress_matrix():
+    """Reference: repository_test.go:287 TestCanReachEgress (mirrored)."""
+    repo = Repository()
+    tag1 = LabelArray.parse("tag1")
+    repo.add(Rule(endpoint_selector=es("foo"), labels=tag1, egress=[
+        EgressRule(to_endpoints=[es("bar")])]))
+    repo.add(Rule(endpoint_selector=es("groupA"), labels=tag1, egress=[
+        EgressRule(to_requires=[es("groupA")])]))
+
+    assert repo.allows_egress(ctx(["foo"], ["bar"])) == Decision.ALLOWED
+    assert repo.allows_egress(
+        ctx(["foo", "groupA"], ["bar", "groupA"])) == Decision.ALLOWED
+    # egress from groupA member to non-groupA => denied by ToRequires
+    assert repo.allows_egress(
+        ctx(["foo", "groupA"], ["bar", "groupB"])) == Decision.DENIED
+    assert repo.allows_egress(ctx(["baz"], ["bar"])) == Decision.DENIED
+
+
+def test_from_requires_denies_even_with_allow():
+    """FromRequires failure takes precedence over a matching allow in the
+    same rule (reference: rule.go:352 comment — separate loops)."""
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_requires=[es("trusted")],
+                    from_endpoints=[es("foo")])]))
+    # foo without trusted: the allow in the same rule must NOT win.
+    assert repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.DENIED
+    assert repo.allows_ingress(
+        ctx(["foo", "trusted"], ["bar"])) == Decision.ALLOWED
+
+
+def test_l3_dependent_l4_verdict():
+    """L3 rule with ToPorts defers to L4 stage; port context decides."""
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("foo")],
+                    to_ports=[PortRule(ports=[
+                        PortProtocol(port="80", protocol="TCP")])])]))
+    # No port context: label stage undecided -> denied.
+    assert repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.DENIED
+    # Correct port: allowed at L4 stage.
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [Port(80, "TCP")])) == Decision.ALLOWED
+    # Wrong port: denied.
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [Port(81, "TCP")])) == Decision.DENIED
+    # Wrong peer: denied.
+    assert repo.allows_ingress(
+        ctx(["baz"], ["bar"], [Port(80, "TCP")])) == Decision.DENIED
+
+
+def test_l4_any_proto_expands_tcp_udp():
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(to_ports=[PortRule(ports=[
+            PortProtocol(port="53", protocol="ANY")])])]))
+    l4 = repo.resolve_l4_ingress_policy(ctx([], ["bar"]))
+    assert set(l4.keys()) == {"53/TCP", "53/UDP"}
+
+
+def test_l4_port_context_any_checks_both():
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(to_ports=[PortRule(ports=[
+            PortProtocol(port="8080", protocol="UDP")])])]))
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [Port(8080, "ANY")])) == Decision.ALLOWED
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [Port(8080, "TCP")])) == Decision.DENIED
+
+
+def test_l4_from_requires_folded_into_l4_stage():
+    """Reference: repository_test.go:685 TestL3DependentL4IngressFromRequires:
+    FromRequires of any rule selecting the target is enforced at L4."""
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("foo")],
+                    to_ports=[PortRule(ports=[
+                        PortProtocol(port="80", protocol="TCP")])]),
+        IngressRule(from_requires=[es("trusted")]),
+    ]))
+    assert repo.allows_ingress(
+        ctx(["foo", "trusted"], ["bar"], [Port(80, "TCP")])) == Decision.ALLOWED
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [Port(80, "TCP")])) == Decision.DENIED
+
+
+def test_wildcard_from_endpoints_allows_all():
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[EndpointSelector()])]))
+    assert repo.allows_ingress(ctx(["anything"], ["bar"])) == Decision.ALLOWED
+
+
+def test_ingress_rule_no_from_block_does_not_allow():
+    """An IngressRule with only ToPorts has empty source selectors; with no
+    L3 allow it still resolves at L4 as allow-all-at-L3 for that port."""
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(to_ports=[PortRule(ports=[
+            PortProtocol(port="80", protocol="TCP")])])]))
+    l4 = repo.resolve_l4_ingress_policy(ctx([], ["bar"]))
+    assert l4["80/TCP"].allows_all_at_l3()
+    assert repo.allows_ingress(
+        ctx(["whoever"], ["bar"], [Port(80, "TCP")])) == Decision.ALLOWED
+
+
+def test_l4_merge_same_port_appends_endpoints():
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("foo")],
+                    to_ports=[PortRule(ports=[
+                        PortProtocol(port="80", protocol="TCP")])])]))
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("baz")],
+                    to_ports=[PortRule(ports=[
+                        PortProtocol(port="80", protocol="TCP")])])]))
+    l4 = repo.resolve_l4_ingress_policy(ctx([], ["bar"]))
+    flt = l4["80/TCP"]
+    assert not flt.allows_all_at_l3()
+    assert any(s.matches(LabelArray.parse_select("foo")) for s in flt.endpoints)
+    assert any(s.matches(LabelArray.parse_select("baz")) for s in flt.endpoints)
+
+
+def test_l7_parser_conflict_raises():
+    """HTTP and Kafka on the same port/proto must conflict
+    (reference: rule.go:56-61 mergeL4Port parser mismatch)."""
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(to_ports=[PortRule(
+            ports=[PortProtocol(port="80", protocol="TCP")],
+            rules=L7Rules(http=[PortRuleHTTP(method="GET", path="/")]))])]))
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(to_ports=[PortRule(
+            ports=[PortProtocol(port="80", protocol="TCP")],
+            rules=L7Rules(kafka=[PortRuleKafka(topic="t")]))])]))
+    with pytest.raises(PolicyError):
+        repo.resolve_l4_ingress_policy(ctx([], ["bar"]))
+
+
+def test_l7_rules_merge_dedup():
+    repo = Repository()
+    http = PortRuleHTTP(method="GET", path="/public")
+    for _ in range(2):
+        repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+            IngressRule(to_ports=[PortRule(
+                ports=[PortProtocol(port="80", protocol="TCP")],
+                rules=L7Rules(http=[http]))])]))
+    l4 = repo.resolve_l4_ingress_policy(ctx([], ["bar"]))
+    flt = l4["80/TCP"]
+    assert flt.l7_parser == "http"
+    (rules,) = flt.l7_rules_per_ep.values()
+    assert rules.http == [http]
+
+
+def test_wildcard_l3_l4_rule_wildcards_l7():
+    """An L3-only allow overlapping an L7 filter forces L7 allow-all for
+    those peers (reference: repository.go:128-170 + TestWildcardL3RulesIngress)."""
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("l3peer")])]))
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("l7peer")],
+                    to_ports=[PortRule(
+                        ports=[PortProtocol(port="80", protocol="TCP")],
+                        rules=L7Rules(http=[PortRuleHTTP(path="/private")]))])]))
+    l4 = repo.resolve_l4_ingress_policy(ctx([], ["bar"]))
+    flt = l4["80/TCP"]
+    l3sel = [s for s in flt.l7_rules_per_ep
+             if s.matches(LabelArray.parse_select("l3peer"))]
+    assert l3sel, "L3-only peer must appear in L7 rules map"
+    # wildcarded: HTTP allow-all rule
+    assert flt.l7_rules_per_ep[l3sel[0]].http == [PortRuleHTTP()]
+
+
+def test_egress_l4_resolution():
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("foo"), egress=[
+        EgressRule(to_endpoints=[es("bar")],
+                   to_ports=[PortRule(ports=[
+                       PortProtocol(port="443", protocol="TCP")])])]))
+    l4 = repo.resolve_l4_egress_policy(ctx(["foo"], []))
+    assert "443/TCP" in l4
+    assert not l4["443/TCP"].ingress
+
+
+def test_cidr_policy_resolution():
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("foo"), egress=[
+        EgressRule(to_cidr=["10.0.0.0/8", "192.168.1.0/24"])]))
+    repo.add(Rule(endpoint_selector=es("foo"), egress=[
+        EgressRule(to_cidr_set=[CIDRRule(cidr="172.16.0.0/12",
+                                         except_cidrs=("172.16.5.0/24",))])]))
+    cidr = repo.resolve_cidr_policy(ctx([], ["foo"]))
+    assert cidr.egress.covers("10.1.2.3")
+    assert cidr.egress.covers("192.168.1.77")
+    assert cidr.egress.covers("172.16.4.1")
+    assert not cidr.egress.covers("172.16.5.1")  # excepted
+    assert not cidr.egress.covers("8.8.8.8")
+    s4, _ = cidr.to_bpf_data()
+    assert s4 == sorted(s4, reverse=True)
+    assert 8 in s4 and 24 in s4
+
+
+def test_cidr_ingress_l3_only_counted():
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_cidr=["10.0.0.0/8"])]))
+    cidr = repo.resolve_cidr_policy(ctx([], ["bar"]))
+    assert cidr.ingress.covers("10.9.9.9")
+
+
+def test_policy_trace_output():
+    repo = Repository()
+    _load_can_reach_rules(repo)
+    c = traced_context(LabelArray.parse_select("foo"),
+                       LabelArray.parse_select("bar"))
+    verdict = repo.allows_ingress(c)
+    out = c.trace_output()
+    assert verdict == Decision.ALLOWED
+    assert "Found all required labels" in out
+    assert "selected" in out
+    assert "Label verdict: allowed" in out
+
+
+def test_revision_in_l4_policy():
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("foo")])]))
+    pol = repo.resolve_l4_policy(ctx(["foo"], ["bar"]))
+    assert pol.revision == repo.revision

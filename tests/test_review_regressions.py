@@ -1,0 +1,115 @@
+"""Regression tests for review findings on the phase-1 semantics core."""
+
+import time
+
+import pytest
+
+from cilium_tpu.identity import LocalIdentityAllocator
+from cilium_tpu.labels import LabelArray, Labels
+from cilium_tpu.policy.api import (EndpointSelector, FQDNSelector,
+                                   IngressRule, L7Rules, PolicyError,
+                                   PortProtocol, PortRule, PortRuleHTTP,
+                                   Rule)
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.trace import Port, SearchContext
+from cilium_tpu.policy.api import Decision
+
+
+def es(*labels):
+    return EndpointSelector.parse(*labels)
+
+
+def ctx(frm, to, dports=None):
+    return SearchContext(from_labels=LabelArray.parse_select(*frm),
+                         to_labels=LabelArray.parse_select(*to),
+                         dports=list(dports or []))
+
+
+def test_l7_rules_require_tcp():
+    """L7 rules on ANY/UDP ports must be rejected at sanitize
+    (reference: rule_validation.go:324) — otherwise the UDP side of an
+    ANY expansion silently drops the L7 restriction (fail-open)."""
+    for proto in ("ANY", "UDP"):
+        r = Rule(endpoint_selector=es("a"), ingress=[
+            IngressRule(to_ports=[PortRule(
+                ports=[PortProtocol(port="80", protocol=proto)],
+                rules=L7Rules(http=[PortRuleHTTP(path="/x")]))])])
+        with pytest.raises(PolicyError):
+            r.sanitize()
+
+
+def test_fqdn_regex_linear_time():
+    """The FQDN validation pattern must not backtrack catastrophically."""
+    evil = "a" * 64 + "!"
+    t0 = time.monotonic()
+    with pytest.raises(PolicyError):
+        FQDNSelector(match_name=evil).sanitize()
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_identity_free_id_respects_cluster_bits():
+    """With cluster_id>0 the free-ID scan must compare full numeric IDs,
+    not base IDs, or live identities get reissued after wrap."""
+    a = LocalIdentityAllocator(cluster_id=1)
+    first, _ = a.allocate(Labels.from_model(["k8s:app=first"]))
+    # Force the counter to wrap back onto first's base ID.
+    a._next = first.id & 0xFFFF
+    second, _ = a.allocate(Labels.from_model(["k8s:app=second"]))
+    assert second.id != first.id
+    assert a.lookup_by_id(first.id).labels is first.labels
+
+
+def test_wildcard_l3_peer_added_to_filter_endpoints():
+    """An L3-only allow overlapping an L7 filter must add the peer to the
+    filter's endpoint list so L4 coverage checks allow it
+    (reference: repository.go:162)."""
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("l3peer")])]))
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("l7peer")],
+                    to_ports=[PortRule(
+                        ports=[PortProtocol(port="80", protocol="TCP")],
+                        rules=L7Rules(http=[PortRuleHTTP(path="/private")]))])]))
+    l4 = repo.resolve_l4_ingress_policy(ctx([], ["bar"]))
+    flt = l4["80/TCP"]
+    assert flt.matches_labels(LabelArray.parse_select("l3peer"))
+    assert l4.contains_all_l3_l4(LabelArray.parse_select("l3peer"),
+                                 [Port(80, "TCP")]) == Decision.ALLOWED
+
+
+def test_wildcard_l3_overwrites_restrictive_l7():
+    """A later L3-only allow must widen an existing restrictive L7 entry
+    for the same selector to allow-all (reference overwrites)."""
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("peer")],
+                    to_ports=[PortRule(
+                        ports=[PortProtocol(port="80", protocol="TCP")],
+                        rules=L7Rules(http=[PortRuleHTTP(path="/only")]))])]))
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("peer")])]))
+    l4 = repo.resolve_l4_ingress_policy(ctx([], ["bar"]))
+    flt = l4["80/TCP"]
+    sel = es("peer")
+    assert flt.l7_rules_per_ep[sel].http == [PortRuleHTTP()]
+
+
+def test_any_proto_l4_allow_wildcards_l7():
+    """A port-ANY L4-only allow must wildcard L7 on the TCP filter
+    (ANY expands to TCP/UDP in the wildcard pass too)."""
+    repo = Repository()
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("x")],
+                    to_ports=[PortRule(ports=[
+                        PortProtocol(port="80", protocol="ANY")])])]))
+    repo.add(Rule(endpoint_selector=es("bar"), ingress=[
+        IngressRule(from_endpoints=[es("y")],
+                    to_ports=[PortRule(
+                        ports=[PortProtocol(port="80", protocol="TCP")],
+                        rules=L7Rules(http=[PortRuleHTTP(path="/p")]))])]))
+    l4 = repo.resolve_l4_ingress_policy(ctx([], ["bar"]))
+    flt = l4["80/TCP"]
+    rules = flt.l7_rules_per_ep.get_relevant_rules(
+        LabelArray.parse_select("x"))
+    assert rules.http == [PortRuleHTTP()]
