@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Benchmark: BASELINE config 1 — L3/L4 CIDR+port policy verdict throughput.
+
+Builds a 100-rule CIDR+port policy (BASELINE.json configs[0]), compiles it
+to device tensors, and streams synthetic packet batches through the fused
+datapath step (ipcache LPM -> 3-stage policy verdict -> counters).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is measured throughput / the 10M verdicts/s north-star target
+(BASELINE.md; the reference repo publishes no absolute numbers).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_config1(n_rules=100, n_endpoints=16, seed=7):
+    """100 CIDR+port allow rules -> (CompiledPolicy, CompiledLPM, oracle)."""
+    from cilium_tpu.compiler.lpm import compile_lpm
+    from cilium_tpu.compiler.policy_tables import compile_endpoints
+    from cilium_tpu.policy.mapstate import (EGRESS, PolicyKey,
+                                            PolicyMapState,
+                                            PolicyMapStateEntry)
+    rng = np.random.default_rng(seed)
+    # Each rule: a /16 or /24 CIDR gets a distinct identity + a port allow.
+    prefixes = {}
+    states = [PolicyMapState() for _ in range(n_endpoints)]
+    ident = 256
+    for i in range(n_rules):
+        plen = int(rng.choice([16, 24]))
+        addr = f"{rng.integers(1, 224)}.{rng.integers(0, 256)}." + \
+            (f"{rng.integers(0, 256)}.0" if plen == 24 else "0.0")
+        prefixes[f"{addr}/{plen}"] = ident
+        port = int(rng.integers(1, 65536))
+        for st in states:
+            st[PolicyKey(identity=ident, dest_port=port, nexthdr=6,
+                         direction=EGRESS)] = PolicyMapStateEntry()
+        # some rules also allow the identity at L3
+        if i % 5 == 0:
+            for st in states:
+                st[PolicyKey(identity=ident,
+                             direction=EGRESS)] = PolicyMapStateEntry()
+        ident += 1
+    compiled_policy = compile_endpoints(states, revision=1)
+    compiled_lpm = compile_lpm(prefixes)
+    return compiled_policy, compiled_lpm, states, prefixes
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from cilium_tpu.datapath.pipeline import RawPacketBatch, make_step
+    from cilium_tpu.datapath.verdict import Counters
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    compiled_policy, compiled_lpm, states, prefixes = build_config1()
+    step, tables, counters = make_step(compiled_policy, compiled_lpm)
+
+    rng = np.random.default_rng(1)
+    pkt = RawPacketBatch(
+        endpoint=jnp.asarray(rng.integers(0, compiled_policy.num_endpoints,
+                                          batch, dtype=np.int32)),
+        src_addr=jnp.asarray(rng.integers(0, 2 ** 32, batch,
+                                          dtype=np.uint32).view(np.int32)),
+        dport=jnp.asarray(rng.integers(1, 65536, batch, dtype=np.int32)),
+        proto=jnp.asarray(np.full(batch, 6, np.int32)),
+        direction=jnp.asarray(np.ones(batch, np.int32)),
+        length=jnp.asarray(np.full(batch, 512, np.int32)),
+        is_fragment=jnp.asarray(np.zeros(batch, np.int32)))
+
+    # warmup / compile
+    verdict, identity, counters = step(tables, counters, pkt)
+    verdict.block_until_ready()
+
+    iters = 30
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        verdict, identity, counters = step(tables, counters, pkt)
+        verdict.block_until_ready()
+        lat.append(time.perf_counter() - t1)
+    elapsed = time.perf_counter() - t0
+    vps = iters * batch / elapsed
+    p99_us = float(np.percentile(np.array(lat), 99) * 1e6)
+
+    target = 10_000_000.0  # BASELINE.md north star: >=10M verdicts/s
+    print(json.dumps({
+        "metric": "policy_verdicts_per_sec_config1_100rules",
+        "value": round(vps),
+        "unit": "verdicts/s",
+        "vs_baseline": round(vps / target, 3),
+        "extra": {"batch": batch, "iters": iters,
+                  "p99_batch_latency_us": round(p99_us, 1),
+                  "device": str(jax.devices()[0]),
+                  "policy_entries": compiled_policy.entry_count(),
+                  "lpm_entries": compiled_lpm.entry_count()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

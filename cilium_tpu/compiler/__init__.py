@@ -1,0 +1,14 @@
+"""Policy compiler: host-side lowering of resolved policy to dense tensors.
+
+Artifacts are deterministic and versioned (revision == buffer generation):
+  * stacked per-endpoint exact-match hash tables (the policymap analog),
+  * LPM structures (per-prefix-length masked hash tables, ≤40 lengths),
+  * DFA transition tables for L7 regexes (``regexc``).
+
+The device kernels in ``cilium_tpu.ops`` and ``cilium_tpu.datapath``
+consume these tensors; they never see rule objects.
+"""
+
+from .hashtab import HashTable, build_hash_table
+from .policy_tables import CompiledPolicy, compile_endpoints
+from .lpm import CompiledLPM, compile_lpm

@@ -1,0 +1,7 @@
+"""The batched TPU datapath: verdict engine, conntrack, LB, ipcache,
+prefilter — the re-design of the reference's eBPF programs (bpf/*.c) as
+tensor kernels over compiled policy artifacts.
+"""
+
+from .verdict import (PacketBatch, VerdictEngine, VERDICT_ALLOW,
+                      VERDICT_DROP, VERDICT_DROP_FRAG)

@@ -1,0 +1,9 @@
+"""Device kernels (JAX/Pallas): batched hash lookup, LPM, DFA evaluation.
+
+Everything here is shape-static, jit-safe, and scalar-loop-free: lookups
+are gathers, probes are statically bounded by the compiler's recorded
+``max_probe``, control flow is `where`/`scan` only.
+"""
+
+from .hashtab_ops import batched_lookup, hash_mix_jnp
+from .lpm_ops import lpm_lookup

@@ -1,0 +1,89 @@
+"""Batched open-addressing hash lookup (device side).
+
+Replaces the reference's per-packet in-kernel BPF map lookups
+(bpf/lib/policy.h:61-96 — up to 3 hash lookups/packet) with one batched
+gather-based probe: for a batch of B queries each probing K slots, the
+lookup is K gathers over an [E*S] flat table — pure VPU work that XLA
+fuses, no host round-trips.
+
+Implementation notes for this TPU platform:
+  * all arithmetic is int32 (uint32 is bit-identical for mul/add/xor under
+    two's complement; logical shifts via lax.shift_right_logical) — the
+    host builder (compiler.hashtab.hash_mix) matches bit-for-bit;
+  * NO axis-1 advanced-indexing selects (x[iota, argmax]): they lower to a
+    catastrophically slow gather here. Keys are unique per table, so at
+    most one probe slot matches and masked sums replace first-hit selects.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# int32 bit-patterns of the uint32 mixing constants.
+_C1 = int(np.array(0x9E3779B1, np.uint32).view(np.int32))
+_C2 = int(np.array(0x85EBCA6B, np.uint32).view(np.int32))
+_C3 = int(np.array(0xC2B2AE35, np.uint32).view(np.int32))
+
+
+def hash_mix_jnp(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """int32 mix — bit-identical to compiler.hashtab.hash_mix (uint32)."""
+    a = a.astype(jnp.int32)
+    b = b.astype(jnp.int32)
+    h = a * _C1
+    h = h ^ lax.shift_right_logical(h, 15)
+    h = h + b * _C2
+    h = h ^ lax.shift_right_logical(h, 13)
+    h = h * _C3
+    h = h ^ lax.shift_right_logical(h, 16)
+    return h
+
+
+def batched_lookup(key_a: jnp.ndarray, key_b: jnp.ndarray,
+                   value: jnp.ndarray,
+                   q_a: jnp.ndarray, q_b: jnp.ndarray,
+                   max_probe: int,
+                   row: Optional[jnp.ndarray] = None
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Probe stacked tables for a batch of queries.
+
+    key_a/key_b/value: [S] or [E, S] int32 table words (key_b==0: empty).
+    q_a/q_b: [B] int32 query words. row: [B] table row index when tables
+    are stacked (required iff tables are 2-D).
+
+    Returns (found [B] bool, value [B] int32, flat_slot [B] int32) where
+    flat_slot indexes the flattened [E*S] table (for counter scatter).
+    """
+    stacked = key_a.ndim == 2
+    slots = key_a.shape[-1]
+    mask = jnp.int32(slots - 1)
+    flat_a = key_a.reshape(-1)
+    flat_b = key_b.reshape(-1)
+    flat_v = value.reshape(-1)
+
+    h = hash_mix_jnp(q_a, q_b)
+    base = h & mask
+    # [B, K] probe slots — K is a compile-time constant from the builder.
+    probes = (base[:, None] +
+              jnp.arange(max_probe, dtype=jnp.int32)[None, :]) & mask
+    if stacked:
+        flat_idx = row.astype(jnp.int32)[:, None] * jnp.int32(slots) + probes
+    else:
+        flat_idx = probes
+
+    got_a = flat_a[flat_idx]          # [B, K]
+    got_b = flat_b[flat_idx]
+    got_v = flat_v[flat_idx]
+    hit = (got_a == q_a[:, None]) & (got_b == q_b[:, None]) & (got_b != 0)
+
+    any_hit = jnp.any(hit, axis=1)
+    # Keys are unique per table => at most one probe hits; masked sums
+    # select it without slow axis-1 index selects.
+    val = jnp.sum(jnp.where(hit, got_v, jnp.int32(0)), axis=1)
+    slot = jnp.sum(jnp.where(hit, flat_idx, jnp.int32(0)), axis=1)
+    return any_hit, val, slot

@@ -1,0 +1,75 @@
+"""Batched longest-prefix-match lookup (device side).
+
+The reference's LPM trie walk (bpf/lib/maps.h ipcache, bpf_xdp.c:97
+check_v4) becomes: for each of P distinct prefix lengths (descending), a
+masked exact-match probe; the first (=longest) hit wins. P ≤ 40
+(MaxCIDRPrefixLengths) keeps the [B, P, K] gather volume bounded.
+
+First-hit selection along P uses a cumsum mask (hit & cumsum(hit)==1)
+instead of argmax + take_along_axis — axis-indexed selects are slow on
+this platform (see hashtab_ops).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .hashtab_ops import hash_mix_jnp
+
+# Plain Python int: a module-level jnp scalar would be captured as a
+# device-array constant in every jit and costs a host sync per call on
+# this platform (measured ~200x slowdown).
+LPM_MISS = -1
+
+
+def lpm_lookup(masks: jnp.ndarray, key_a: jnp.ndarray, key_b: jnp.ndarray,
+               value: jnp.ndarray, prefix_lens: jnp.ndarray,
+               addrs: jnp.ndarray, max_probe: int
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """LPM over stacked per-length tables.
+
+    masks: [P] int32; key_a/key_b/value: [P, S] int32; prefix_lens: [P]
+    (descending); addrs: [B] int32 (uint32 addresses bit-cast).
+    Returns (found [B] bool, value [B] int32 — LPM_MISS on miss).
+    """
+    p, slots = key_a.shape
+    if p == 0:
+        b = addrs.shape[0]
+        return jnp.zeros(b, bool), jnp.full(b, LPM_MISS, jnp.int32)
+    mask_slots = jnp.int32(slots - 1)
+
+    masked = addrs.astype(jnp.int32)[:, None] & masks.astype(jnp.int32)[None, :]
+    qb = ((prefix_lens.astype(jnp.int32) << 1) | 1)[None, :]       # [1, P]
+    qb = jnp.broadcast_to(qb, masked.shape)                        # [B, P]
+
+    h = hash_mix_jnp(masked, qb)
+    base = h & mask_slots                                          # [B, P]
+    probes = (base[:, :, None] +
+              jnp.arange(max_probe, dtype=jnp.int32)[None, None, :]) \
+        & mask_slots                                               # [B,P,K]
+    row_off = (jnp.arange(p, dtype=jnp.int32) * jnp.int32(slots))[None, :, None]
+    flat_idx = row_off + probes
+
+    flat_a, flat_b = key_a.reshape(-1), key_b.reshape(-1)
+    flat_v = value.reshape(-1)
+    # Gather with a 2-D index array: 3-D advanced indexing lowers to a
+    # pathologically slow gather on this platform (measured ~10^4 x).
+    b = addrs.shape[0]
+    idx2 = flat_idx.reshape(b, p * max_probe)
+    got_a = flat_a[idx2].reshape(b, p, max_probe)
+    got_b = flat_b[idx2].reshape(b, p, max_probe)
+    got_v = flat_v[idx2].reshape(b, p, max_probe)
+    hit = (got_a == masked[:, :, None]) & (got_b == qb[:, :, None]) & \
+        (got_b != 0)
+
+    # Within one prefix-length table keys are unique: masked sum over K.
+    hit_per_len = jnp.any(hit, axis=2)                             # [B, P]
+    val_per_len = jnp.sum(jnp.where(hit, got_v, jnp.int32(0)), axis=2)
+    # Longest match = first hit in descending-length order.
+    first_mask = hit_per_len & (jnp.cumsum(hit_per_len.astype(jnp.int32),
+                                           axis=1) == 1)
+    any_hit = jnp.any(hit_per_len, axis=1)
+    val = jnp.sum(jnp.where(first_mask, val_per_len, jnp.int32(0)), axis=1)
+    return any_hit, jnp.where(any_hit, val, jnp.int32(LPM_MISS))

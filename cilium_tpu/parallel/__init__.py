@@ -1,0 +1,12 @@
+"""Mesh/sharding helpers: how the datapath scales over TPU chips.
+
+The reference scales per-packet work across CPUs/NICs (per-CPU BPF maps,
+RSS) and across nodes via kvstore replication. Here the analogs are:
+  * ``dp`` mesh axis — the packet batch is sharded across chips (ICI);
+  * ``ep`` mesh axis — stacked per-endpoint policy tables can shard
+    across chips when the table set outgrows one chip's HBM;
+  * control-plane replication (kvstore) stays host-side over DCN.
+"""
+
+from .mesh import (make_mesh, shard_batch, replicate, batch_sharding,
+                   table_sharding)

@@ -1,0 +1,47 @@
+"""Device mesh construction + canonical shardings for the datapath."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"   # packet-batch data parallelism (ICI)
+EP_AXIS = "ep"   # endpoint-table sharding (model-parallel analog)
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              ep_parallel: int = 1) -> Mesh:
+    """A (dp, ep) mesh over the first ``n_devices`` devices.
+
+    ``ep_parallel`` splits devices between batch parallelism and endpoint
+    table sharding; default keeps everything on the dp axis.
+    """
+    devs = jax.devices()[:n_devices] if n_devices else jax.devices()
+    n = len(devs)
+    if n % ep_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by ep={ep_parallel}")
+    arr = np.array(devs).reshape(n // ep_parallel, ep_parallel)
+    return Mesh(arr, axis_names=(DP_AXIS, EP_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, ...] tensors: shard the batch across dp, replicate across ep."""
+    return NamedSharding(mesh, P(DP_AXIS))
+
+
+def table_sharding(mesh: Mesh) -> NamedSharding:
+    """[E, S] policy tables: shard the endpoint axis across ep."""
+    return NamedSharding(mesh, P(EP_AXIS, None))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, tree):
+    """Place every [B]-leading leaf with batch sharding."""
+    sh = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
