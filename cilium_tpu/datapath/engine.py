@@ -1,0 +1,137 @@
+"""The full datapath engine: host orchestrator over compiled tables.
+
+Owns one generation of every device table (policy, ipcache LPM, LB,
+prefilter) plus the mutable conntrack state and counters, and exposes a
+single ``process(batch)`` call — the complete per-packet path of the
+reference (bpf_lxc.c egress/ingress) as one jitted program.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.lpm import CompiledLPM, compile_lpm
+from ..compiler.policy_tables import CompiledPolicy, compile_endpoints
+from ..policy.mapstate import PolicyMapState
+from .conntrack import ConntrackTable, make_ct_state
+from .lb import CompiledLB, LoadBalancer, Service, compile_lb
+from .pipeline import (DatapathTables, FullPacketBatch, FullTables,
+                       build_tables, full_datapath_step)
+from .prefilter import PreFilter
+from .verdict import Counters
+
+
+class Datapath:
+    """One device-resident datapath generation + mutable flow state.
+
+    Swap-on-regenerate: the agent compiles a new generation from the
+    policy repository and calls ``load_policy`` — conntrack state and
+    counters survive the swap when shapes allow (the analog of pinned
+    BPF maps surviving agent restart, daemon/state.go).
+    """
+
+    def __init__(self, ct_slots: int = 1 << 16, ct_probe: int = 8):
+        self.prefilter = PreFilter()
+        self.lb = LoadBalancer()
+        self.ct = ConntrackTable(slots=ct_slots, max_probe=ct_probe)
+        self.compiled_policy: Optional[CompiledPolicy] = None
+        self.compiled_ipcache: Optional[CompiledLPM] = None
+        self.counters: Optional[Counters] = None
+        self.revision = 0
+        self._step = None
+        self._tables: Optional[FullTables] = None
+
+    # -- table loading -------------------------------------------------------
+
+    def load_policy(self, map_states: Sequence[PolicyMapState],
+                    revision: int,
+                    ipcache_prefixes: Optional[Dict[str, int]] = None
+                    ) -> None:
+        self.compiled_policy = compile_endpoints(map_states,
+                                                 revision=revision)
+        if ipcache_prefixes is not None or self.compiled_ipcache is None:
+            self.compiled_ipcache = compile_lpm(ipcache_prefixes or {})
+        self.revision = revision
+        self._rebuild()
+
+    def load_ipcache(self, prefixes: Dict[str, int]) -> None:
+        self.compiled_ipcache = compile_lpm(prefixes)
+        self._rebuild()
+
+    def reload_services(self) -> None:
+        self._rebuild()
+
+    def reload_prefilter(self) -> None:
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        if self.compiled_policy is None:
+            return
+        if self.lb.compiled is None:
+            self.lb._recompile()
+        dp = build_tables(self.compiled_policy, self.compiled_ipcache)
+        pf = self.prefilter._compiled
+        if pf is None or pf.entry_count() == 0:
+            pf = compile_lpm({})
+        self._tables = FullTables(
+            datapath=dp, lb=self.lb.compiled.tables,
+            pf_masks=jnp.asarray(pf.masks), pf_key_a=jnp.asarray(pf.key_a),
+            pf_key_b=jnp.asarray(pf.key_b), pf_value=jnp.asarray(pf.value),
+            pf_plens=jnp.asarray(pf.prefix_lens))
+        n = max(1, self.compiled_policy.num_endpoints *
+                self.compiled_policy.slots)
+        if self.counters is None or self.counters.packets.shape[0] != n:
+            self.counters = Counters(packets=jnp.zeros(n, jnp.uint32),
+                                     bytes=jnp.zeros(n, jnp.uint32))
+        self._step = jax.jit(functools.partial(
+            full_datapath_step,
+            policy_probe=self.compiled_policy.max_probe,
+            lpm_probe=max(1, self.compiled_ipcache.max_probe),
+            pf_probe=max(1, pf.max_probe),
+            lb_probe=self.lb.compiled.max_probe,
+            ct_slots=self.ct.slots, ct_probe=self.ct.max_probe),
+            donate_argnums=(1, 2))
+
+    # -- the hot path --------------------------------------------------------
+
+    def process(self, pkt: FullPacketBatch, now: Optional[int] = None
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Classify a batch. Returns (verdict, event, identity), all [B]."""
+        if self._step is None:
+            raise RuntimeError("no policy loaded")
+        verdict, event, identity, self.ct.state, self.counters = self._step(
+            self._tables, self.ct.state, self.counters, pkt,
+            jnp.int32(now if now is not None else int(time.time())))
+        return verdict, event, identity
+
+    # -- maintenance ---------------------------------------------------------
+
+    def gc(self, now: Optional[int] = None) -> int:
+        return self.ct.gc(now if now is not None else int(time.time()))
+
+
+def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
+                    direction=None, tcp_flags=None, length=None,
+                    is_fragment=None) -> FullPacketBatch:
+    n = len(np.asarray(endpoint))
+    arr = lambda x, d: jnp.asarray(np.asarray(
+        x if x is not None else np.full(n, d), np.int32))
+    import numpy as _np
+
+    def addr(x):
+        a = _np.asarray(x)
+        if a.dtype == _np.uint32:
+            a = a.view(_np.int32)
+        return jnp.asarray(a.astype(_np.int32) if a.dtype != _np.int32 else a)
+
+    return FullPacketBatch(
+        endpoint=arr(endpoint, 0), saddr=addr(saddr), daddr=addr(daddr),
+        sport=arr(sport, 0), dport=arr(dport, 0), proto=arr(proto, 6),
+        direction=arr(direction, 1), tcp_flags=arr(tcp_flags, 0x02),
+        length=arr(length, 100), is_fragment=arr(is_fragment, 0))

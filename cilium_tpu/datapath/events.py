@@ -1,0 +1,41 @@
+"""Datapath event codes: drop reasons + trace points.
+
+Reference: bpf/lib/common.h DROP_* reason codes and bpf/lib/{drop,trace}.h
+perf-ring notifications (decoded by pkg/monitor/datapath_drop.go:28 and
+datapath_trace.go:28). The batched datapath emits one event code per
+packet; the monitor aggregates them host-side.
+"""
+
+from __future__ import annotations
+
+# Forwarding outcomes (positive trace points).
+TRACE_TO_LXC = 0        # delivered to local endpoint
+TRACE_TO_PROXY = 1      # redirected to proxy
+TRACE_TO_HOST = 2
+TRACE_TO_STACK = 3
+TRACE_TO_OVERLAY = 4    # encapped to remote node
+
+# Drop reasons (negative codes, mirroring DROP_* semantics).
+DROP_POLICY = -130          # common.h DROP_POLICY analog
+DROP_FRAG_NOSUPPORT = -131
+DROP_CT_INVALID_HDR = -132
+DROP_PREFILTER = -133       # XDP prefilter (bpf_xdp.c check_filters)
+DROP_POLICY_L7 = -134
+DROP_INVALID = -135
+
+DROP_NAMES = {
+    DROP_POLICY: "Policy denied (L3/L4)",
+    DROP_FRAG_NOSUPPORT: "Fragmented packet not supported",
+    DROP_CT_INVALID_HDR: "Invalid connection tracking header",
+    DROP_PREFILTER: "Prefilter denied",
+    DROP_POLICY_L7: "Policy denied (L7)",
+    DROP_INVALID: "Invalid packet",
+}
+
+TRACE_NAMES = {
+    TRACE_TO_LXC: "to-endpoint",
+    TRACE_TO_PROXY: "to-proxy",
+    TRACE_TO_HOST: "to-host",
+    TRACE_TO_STACK: "to-stack",
+    TRACE_TO_OVERLAY: "to-overlay",
+}

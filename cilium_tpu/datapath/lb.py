@@ -1,0 +1,183 @@
+"""Batched service load balancing: VIP -> backend selection + rev-NAT.
+
+Semantics follow the reference's eBPF LB (bpf/lib/lb.h): a service lookup
+on (VIP, port), slave selection by 5-tuple hash modulo backend count
+(lb4_select_slave), DNAT to the chosen backend, and a reverse-NAT table
+indexed by rev_nat_index for reply translation (lb4_rev_nat). The
+userspace bookkeeping mirrors pkg/maps/lbmap (ipv4.go:43-129).
+
+Compiled form: one hash table (vip, port|proto) -> service index, flat
+backend arrays indexed by [svc_offset + slave], and rev-NAT arrays
+indexed by rev_nat_index.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.hashtab import build_hash_table
+from ..ops.hashtab_ops import batched_lookup, hash_mix_jnp
+
+
+@dataclass(frozen=True)
+class Backend:
+    addr: int       # uint32 IPv4 as int
+    port: int
+
+
+@dataclass
+class Service:
+    """A service frontend (reference: pkg/loadbalancer types)."""
+
+    vip: int        # uint32 IPv4
+    port: int
+    proto: int = 6
+    backends: List[Backend] = field(default_factory=list)
+    rev_nat_index: int = 0  # assigned at compile/insert time
+
+
+class LBTables(NamedTuple):
+    """Device LB state."""
+
+    svc_key_a: jnp.ndarray   # [S] vip
+    svc_key_b: jnp.ndarray   # [S] port<<16 | proto<<8 | 1
+    svc_value: jnp.ndarray   # [S] service index
+    svc_count: jnp.ndarray   # [NSVC] backend count
+    svc_offset: jnp.ndarray  # [NSVC] offset into backend arrays
+    svc_revnat: jnp.ndarray  # [NSVC] rev-NAT index
+    b_addr: jnp.ndarray      # [NB]
+    b_port: jnp.ndarray      # [NB]
+    rev_vip: jnp.ndarray     # [NR] rev_nat_index -> original VIP
+    rev_port: jnp.ndarray    # [NR]
+
+
+@dataclass
+class CompiledLB:
+    tables: LBTables
+    max_probe: int
+    num_services: int
+    num_backends: int
+
+
+def compile_lb(services: Sequence[Service]) -> CompiledLB:
+    """Lower a service list to device tables. rev_nat_index is 1-based
+    (0 == no NAT), matching the reference's lbmap convention."""
+    entries = {}
+    counts, offsets, revnats = [], [], []
+    b_addr, b_port = [], []
+    rev_vip = [0]
+    rev_port = [0]
+    for i, svc in enumerate(services):
+        svc.rev_nat_index = i + 1
+        key = (svc.vip & 0xFFFFFFFF,
+               ((svc.port & 0xFFFF) << 16) | ((svc.proto & 0xFF) << 8) | 1)
+        entries[key] = i
+        offsets.append(len(b_addr))
+        counts.append(len(svc.backends))
+        revnats.append(svc.rev_nat_index)
+        for b in svc.backends:
+            b_addr.append(b.addr & 0xFFFFFFFF)
+            b_port.append(b.port)
+        rev_vip.append(svc.vip & 0xFFFFFFFF)
+        rev_port.append(svc.port)
+    t = build_hash_table(entries) if entries else build_hash_table(
+        {(0, 1): 0}, min_slots=8)
+    as_i32 = lambda x: jnp.asarray(np.asarray(x, np.uint32).view(np.int32)
+                                   if np.asarray(x).dtype != np.int32
+                                   else np.asarray(x, np.int32))
+    tables = LBTables(
+        svc_key_a=jnp.asarray(t.key_a), svc_key_b=jnp.asarray(t.key_b),
+        svc_value=jnp.asarray(t.value),
+        svc_count=jnp.asarray(np.asarray(counts or [0], np.int32)),
+        svc_offset=jnp.asarray(np.asarray(offsets or [0], np.int32)),
+        svc_revnat=jnp.asarray(np.asarray(revnats or [0], np.int32)),
+        b_addr=as_i32(b_addr or [0]),
+        b_port=jnp.asarray(np.asarray(b_port or [0], np.int32)),
+        rev_vip=as_i32(rev_vip), rev_port=jnp.asarray(
+            np.asarray(rev_port, np.int32)))
+    return CompiledLB(tables=tables, max_probe=t.max_probe,
+                      num_services=len(services), num_backends=len(b_addr))
+
+
+def lb_step(tables: LBTables, daddr, dport, proto, saddr, sport,
+            *, max_probe: int
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Service DNAT for a batch.
+
+    Returns (new_daddr, new_dport, rev_nat_idx, is_service) — non-service
+    packets pass through unchanged (rev_nat 0).
+    Reference: lb4_lookup_service + lb4_select_slave + lb4_local.
+    """
+    qb = ((dport & 0xFFFF) << 16) | ((proto & 0xFF) << 8) | 1
+    found, svc_idx, _ = batched_lookup(
+        tables.svc_key_a, tables.svc_key_b, tables.svc_value,
+        daddr, qb, max_probe)
+    svc_idx = jnp.where(found, svc_idx, jnp.int32(0))
+    count = tables.svc_count[svc_idx]
+    offset = tables.svc_offset[svc_idx]
+    # Slave selection by packet 5-tuple hash (lb.h lb4_hash: jhash of
+    # src/dst/ports) — any uniform deterministic hash preserves semantics.
+    h = hash_mix_jnp(hash_mix_jnp(saddr, daddr),
+                     hash_mix_jnp(((sport & 0xFFFF) << 16) | (dport & 0xFFFF),
+                                  proto))
+    slave = jnp.where(count > 0,
+                      jnp.abs(h) % jnp.maximum(count, 1), jnp.int32(0))
+    bidx = offset + slave
+    ok = found & (count > 0)
+    new_daddr = jnp.where(ok, tables.b_addr[bidx], daddr)
+    new_dport = jnp.where(ok, tables.b_port[bidx], dport)
+    rev_nat = jnp.where(ok, tables.svc_revnat[svc_idx], jnp.int32(0))
+    return new_daddr, new_dport, rev_nat, ok
+
+
+def lb_rev_nat(tables: LBTables, saddr, sport, rev_nat_idx
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reply-path reverse NAT: restore VIP/port for flows whose CT entry
+    carries a rev_nat_index (reference: lb4_rev_nat)."""
+    has = rev_nat_idx > 0
+    idx = jnp.where(has, rev_nat_idx, jnp.int32(0))
+    return (jnp.where(has, tables.rev_vip[idx], saddr),
+            jnp.where(has, tables.rev_port[idx], sport))
+
+
+class LoadBalancer:
+    """Host-side service registry + compiled device tables
+    (pkg/service + pkg/maps/lbmap analog)."""
+
+    def __init__(self):
+        self._services: Dict[Tuple[int, int, int], Service] = {}
+        self.compiled: Optional[CompiledLB] = None
+        self._step = None
+
+    def upsert_service(self, svc: Service) -> None:
+        self._services[(svc.vip, svc.port, svc.proto)] = svc
+        self._recompile()
+
+    def delete_service(self, vip: int, port: int, proto: int = 6) -> bool:
+        existed = self._services.pop((vip, port, proto), None) is not None
+        if existed:
+            self._recompile()
+        return existed
+
+    def _recompile(self):
+        self.compiled = compile_lb(list(self._services.values()))
+        self._step = jax.jit(functools.partial(
+            lb_step, max_probe=self.compiled.max_probe))
+
+    def __len__(self):
+        return len(self._services)
+
+    def step(self, daddr, dport, proto, saddr, sport):
+        if self.compiled is None:
+            self._recompile()
+        return self._step(self.compiled.tables, daddr, dport, proto,
+                          saddr, sport)
+
+    def rev_nat(self, saddr, sport, rev_nat_idx):
+        return lb_rev_nat(self.compiled.tables, saddr, sport, rev_nat_idx)

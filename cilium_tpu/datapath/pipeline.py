@@ -19,6 +19,7 @@ from ..compiler.lpm import CompiledLPM
 from ..compiler.policy_tables import CompiledPolicy
 from ..ops.hashtab_ops import batched_lookup
 from ..ops.lpm_ops import lpm_lookup
+from .lb import CompiledLB, LBTables
 from .verdict import Counters, PacketBatch, verdict_step
 
 # Identity assigned when the ipcache has no entry for the address
@@ -96,3 +97,112 @@ def make_step(compiled_policy: CompiledPolicy, compiled_lpm: CompiledLPM):
         datapath_step, policy_probe=compiled_policy.max_probe,
         lpm_probe=compiled_lpm.max_probe), donate_argnums=(1,))
     return step, tables, counters
+
+
+# ---------------------------------------------------------------------------
+# Full datapath: prefilter -> LB -> conntrack -> ipcache -> policy -> create
+# ---------------------------------------------------------------------------
+
+class FullPacketBatch(NamedTuple):
+    """Wire-level metadata for the full path, all [B] int32."""
+
+    endpoint: jnp.ndarray
+    saddr: jnp.ndarray
+    daddr: jnp.ndarray
+    sport: jnp.ndarray
+    dport: jnp.ndarray
+    proto: jnp.ndarray
+    direction: jnp.ndarray
+    tcp_flags: jnp.ndarray
+    length: jnp.ndarray
+    is_fragment: jnp.ndarray
+
+
+class FullTables(NamedTuple):
+    datapath: DatapathTables          # policy + ipcache LPM
+    lb: LBTables                      # service tables
+    pf_masks: jnp.ndarray             # prefilter deny LPM
+    pf_key_a: jnp.ndarray
+    pf_key_b: jnp.ndarray
+    pf_value: jnp.ndarray
+    pf_plens: jnp.ndarray
+
+
+def full_datapath_step(tables: FullTables, ct, counters: Counters,
+                       pkt: FullPacketBatch, now: jnp.ndarray, *,
+                       policy_probe: int, lpm_probe: int, pf_probe: int,
+                       lb_probe: int, ct_slots: int, ct_probe: int):
+    """The batched equivalent of the reference's per-packet egress path
+    (bpf_lxc.c:432 handle_ipv4_from_lxc): XDP prefilter drop, service
+    DNAT (lb4_local), conntrack lookup, ipcache identity resolve, policy
+    verdict for CT_NEW flows, CT entry creation gated on the verdict.
+
+    Returns (verdict [B], event [B], identity [B], ct', counters').
+    Verdict: -N drop code / 0 allow / >0 proxy port.
+    """
+    from .conntrack import CT_NEW, CTBatch, ct_step
+    from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_PREFILTER,
+                         TRACE_TO_LXC, TRACE_TO_PROXY)
+    from .lb import lb_step
+    from .verdict import VERDICT_ALLOW, VERDICT_DROP, VERDICT_DROP_FRAG
+
+    # 1. Prefilter (bpf_xdp.c:158 check_filters).
+    if tables.pf_key_a.shape[0] > 0:
+        pf_hit, _ = lpm_lookup(tables.pf_masks, tables.pf_key_a,
+                               tables.pf_key_b, tables.pf_value,
+                               tables.pf_plens, pkt.saddr, pf_probe)
+    else:
+        pf_hit = jnp.zeros(pkt.saddr.shape[0], bool)
+
+    # 2. Service LB DNAT (lb.h lb4_local).
+    daddr, dport, rev_nat, is_svc = lb_step(
+        tables.lb, pkt.daddr, pkt.dport, pkt.proto, pkt.saddr, pkt.sport,
+        max_probe=lb_probe)
+
+    # 3. Conntrack on the DNAT'd tuple (bpf_lxc.c:501 ct_lookup4) — the
+    # create decision comes after the policy verdict.
+    ctb = CTBatch(saddr=pkt.saddr, daddr=daddr, sport=pkt.sport,
+                  dport=dport, proto=pkt.proto, direction=pkt.direction,
+                  tcp_flags=pkt.tcp_flags,
+                  related=jnp.zeros_like(pkt.proto))
+
+    # 4. ipcache: remote identity from the *peer* address (src on
+    # ingress, dst on egress — bpf_lxc.c:205/eps.h lookup).
+    peer = jnp.where(pkt.direction == 0, pkt.saddr, daddr)
+    found, ident = lpm_lookup(tables.datapath.lpm_masks,
+                              tables.datapath.lpm_key_a,
+                              tables.datapath.lpm_key_b,
+                              tables.datapath.lpm_value,
+                              tables.datapath.lpm_plens, peer, lpm_probe)
+    identity = jnp.where(found, ident, jnp.int32(WORLD_IDENTITY))
+
+    # 5. Policy verdict (bpf/lib/policy.h __policy_can_access).
+    vb = PacketBatch(endpoint=pkt.endpoint, identity=identity,
+                     dport=dport, proto=pkt.proto,
+                     direction=pkt.direction, length=pkt.length,
+                     is_fragment=pkt.is_fragment)
+    pol_verdict, counters = verdict_step(
+        tables.datapath.key_id, tables.datapath.key_meta,
+        tables.datapath.value, counters, vb, policy_probe)
+
+    # 6. CT step with creation gated on the policy allowing the flow
+    # (bpf_lxc.c:545 ct_create4 after policy_can_egress).
+    create_ok = (pol_verdict >= 0) & ~pf_hit
+    ct_verdict, ct_rev_nat, ct = ct_step(ct, ctb, now, create_ok,
+                                         slots=ct_slots, max_probe=ct_probe)
+
+    # 7. Final verdict: prefilter drop beats everything; established/
+    # reply flows bypass the policy verdict (conntrack fast path);
+    # CT_NEW flows take the policy verdict.
+    established = ct_verdict != CT_NEW
+    verdict = jnp.where(
+        pf_hit, jnp.int32(VERDICT_DROP),
+        jnp.where(established, jnp.int32(VERDICT_ALLOW), pol_verdict))
+
+    event = jnp.where(
+        pf_hit, jnp.int32(DROP_PREFILTER),
+        jnp.where(verdict == VERDICT_DROP_FRAG, jnp.int32(DROP_FRAG_NOSUPPORT),
+                  jnp.where(verdict < 0, jnp.int32(DROP_POLICY),
+                            jnp.where(verdict > 0, jnp.int32(TRACE_TO_PROXY),
+                                      jnp.int32(TRACE_TO_LXC)))))
+    return verdict, event, identity, ct, counters

@@ -1,0 +1,133 @@
+"""Conntrack semantics tests (mirrors bpf/lib/conntrack.h behavior and
+the ctmap GC sweep)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from cilium_tpu.datapath.conntrack import (CT_CLOSE_TIMEOUT, CT_EGRESS,
+                                           CT_ESTABLISHED, CT_INGRESS,
+                                           CT_LIFETIME_NONTCP,
+                                           CT_LIFETIME_TCP, CT_NEW,
+                                           CT_RELATED, CT_REPLY, CTBatch,
+                                           ConntrackTable, TCP_ACK, TCP_FIN,
+                                           TCP_RST, TCP_SYN)
+
+
+def mkbatch(saddr, daddr, sport, dport, proto=None, direction=None,
+            tcp_flags=None, related=None):
+    n = len(saddr)
+    arr = lambda x, d: jnp.asarray(np.asarray(
+        x if x is not None else np.full(n, d), np.int32))
+    return CTBatch(saddr=arr(saddr, 0), daddr=arr(daddr, 0),
+                   sport=arr(sport, 0), dport=arr(dport, 0),
+                   proto=arr(proto, 6), direction=arr(direction, CT_EGRESS),
+                   tcp_flags=arr(tcp_flags, TCP_SYN),
+                   related=arr(related, 0))
+
+
+def test_new_then_established():
+    ct = ConntrackTable(slots=1024)
+    b = mkbatch([0x0A000001], [0x0A000002], [4242], [80])
+    v, _ = ct.step(b, now=100)
+    assert int(v[0]) == CT_NEW
+    assert ct.entry_count() == 1
+    # same flow again: established
+    b2 = mkbatch([0x0A000001], [0x0A000002], [4242], [80],
+                 tcp_flags=[TCP_ACK])
+    v, _ = ct.step(b2, now=101)
+    assert int(v[0]) == CT_ESTABLISHED
+
+
+def test_reply_direction():
+    ct = ConntrackTable(slots=1024)
+    # egress flow created by the container
+    ct.step(mkbatch([0x0A000001], [0x0A000002], [4242], [80]), now=100)
+    # reply: reversed tuple, opposite direction
+    reply = mkbatch([0x0A000002], [0x0A000001], [80], [4242],
+                    direction=[CT_INGRESS], tcp_flags=[TCP_SYN | TCP_ACK])
+    v, _ = ct.step(reply, now=101)
+    assert int(v[0]) == CT_REPLY
+
+
+def test_related_icmp():
+    ct = ConntrackTable(slots=1024)
+    ct.step(mkbatch([0x0A000001], [0x0A000002], [4242], [80]), now=100)
+    # ICMP error about the flow: reverse lookup with related flag
+    rel = mkbatch([0x0A000002], [0x0A000001], [80], [4242],
+                  direction=[CT_INGRESS], proto=[1], tcp_flags=[0],
+                  related=[1])
+    # ICMP uses same addrs; ports carried from original tuple context
+    v, _ = ct.step(mkbatch([0x0A000002], [0x0A000001], [80], [4242],
+                           direction=[CT_INGRESS], related=[1]), now=101)
+    assert int(v[0]) == CT_RELATED
+
+
+def test_create_mask_gates_creation():
+    ct = ConntrackTable(slots=1024)
+    b = mkbatch([1], [2], [3], [4])
+    v, _ = ct.step(b, now=10, create_mask=jnp.zeros(1, bool))
+    assert int(v[0]) == CT_NEW
+    assert ct.entry_count() == 0
+
+
+def test_expiry_and_gc():
+    ct = ConntrackTable(slots=1024)
+    # UDP flow: 60s lifetime (conntrack.h:32)
+    ct.step(mkbatch([1], [2], [3], [4], proto=[17], tcp_flags=[0]), now=100)
+    assert ct.entry_count() == 1
+    # before expiry: established
+    v, _ = ct.step(mkbatch([1], [2], [3], [4], proto=[17], tcp_flags=[0]),
+                   now=100 + CT_LIFETIME_NONTCP - 1)
+    assert int(v[0]) == CT_ESTABLISHED
+    # after expiry: new again
+    v, _ = ct.step(mkbatch([1], [2], [3], [4], proto=[17], tcp_flags=[0]),
+                   now=100 + 2 * CT_LIFETIME_NONTCP + 2,
+                   create_mask=jnp.zeros(1, bool))
+    assert int(v[0]) == CT_NEW
+    # gc removes it
+    n = ct.gc(now=100 + 3 * CT_LIFETIME_NONTCP)
+    assert n == 1
+    assert ct.entry_count() == 0
+
+
+def test_fin_shortens_lifetime():
+    ct = ConntrackTable(slots=1024)
+    ct.step(mkbatch([1], [2], [3], [4], tcp_flags=[TCP_SYN | TCP_ACK]),
+            now=100)
+    # FIN: close timeout (10s)
+    ct.step(mkbatch([1], [2], [3], [4], tcp_flags=[TCP_FIN | TCP_ACK]),
+            now=200)
+    v, _ = ct.step(mkbatch([1], [2], [3], [4], tcp_flags=[TCP_ACK]),
+                   now=200 + CT_CLOSE_TIMEOUT + 1,
+                   create_mask=jnp.zeros(1, bool))
+    assert int(v[0]) == CT_NEW  # entry expired after close timeout
+
+
+def test_batch_many_flows():
+    ct = ConntrackTable(slots=1 << 14)
+    rng = np.random.default_rng(0)
+    n = 2000
+    saddr = rng.integers(1, 2**31, n).astype(np.int32)
+    daddr = rng.integers(1, 2**31, n).astype(np.int32)
+    sport = rng.integers(1024, 65536, n).astype(np.int32)
+    dport = np.full(n, 443, np.int32)
+    b = mkbatch(saddr, daddr, sport, dport)
+    v, _ = ct.step(b, now=100)
+    assert (np.asarray(v) == CT_NEW).all()
+    # nearly all created (within-batch slot races may drop a handful)
+    assert ct.entry_count() >= n - 20
+    v, _ = ct.step(b, now=101)
+    assert (np.asarray(v) == CT_ESTABLISHED).mean() > 0.99
+
+
+def test_rev_nat_stamp_and_return():
+    ct = ConntrackTable(slots=1024)
+    b = mkbatch([0x0A000001], [0x0A000002], [4242], [80])
+    ct.step(b, now=100)
+    ct.stamp_rev_nat(b, jnp.asarray(np.array([7], np.int32)), now=100)
+    # reply carries the rev-NAT index back
+    reply = mkbatch([0x0A000002], [0x0A000001], [80], [4242],
+                    direction=[CT_INGRESS])
+    v, rn = ct.step(reply, now=101)
+    assert int(v[0]) == CT_REPLY
+    assert int(rn[0]) == 7

@@ -1,0 +1,142 @@
+"""End-to-end full-datapath tests: prefilter -> LB -> CT -> ipcache ->
+policy -> CT-create, mirroring the reference's bpf_lxc.c packet walks
+(SURVEY.md §3.3/3.4 call stacks)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from cilium_tpu.compiler.lpm import ipv4_to_u32
+from cilium_tpu.datapath.conntrack import CT_ESTABLISHED
+from cilium_tpu.datapath.engine import Datapath, make_full_batch
+from cilium_tpu.datapath.events import (DROP_POLICY, DROP_PREFILTER,
+                                        TRACE_TO_LXC, TRACE_TO_PROXY)
+from cilium_tpu.datapath.lb import Backend, Service
+from cilium_tpu.datapath.verdict import VERDICT_ALLOW, VERDICT_DROP
+from cilium_tpu.policy.mapstate import (EGRESS, INGRESS, PolicyKey,
+                                        PolicyMapState, PolicyMapStateEntry)
+
+CLIENT_ID = 2001
+SERVER_ID = 2002
+
+
+def build_dp():
+    dp = Datapath(ct_slots=1 << 12)
+    # Endpoint 0's policy: egress to SERVER_ID on 8080/TCP allowed;
+    # L7 proxy on 9090/TCP via wildcard; everything else denied.
+    st = PolicyMapState({
+        PolicyKey(identity=SERVER_ID, dest_port=8080, nexthdr=6,
+                  direction=EGRESS): PolicyMapStateEntry(),
+        PolicyKey(identity=0, dest_port=9090, nexthdr=6,
+                  direction=EGRESS): PolicyMapStateEntry(proxy_port=15001),
+    })
+    ipcache = {
+        "10.1.0.0/16": CLIENT_ID,
+        "10.2.0.0/16": SERVER_ID,
+        "0.0.0.0/0": 2,  # world
+    }
+    dp.lb.upsert_service(Service(
+        vip=ipv4_to_u32("10.96.0.10"), port=80,
+        backends=[Backend(addr=ipv4_to_u32("10.2.0.5"), port=8080)]))
+    dp.load_policy([st], revision=1, ipcache_prefixes=ipcache)
+    return dp
+
+
+def test_egress_allowed_via_service_vip():
+    """Client hits the service VIP:80; LB DNATs to backend 8080 where
+    egress policy allows SERVER_ID -> forwarded."""
+    dp = build_dp()
+    pkt = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[ipv4_to_u32("10.96.0.10")], sport=[40000], dport=[80])
+    verdict, event, identity = dp.process(pkt, now=100)
+    assert int(verdict[0]) == VERDICT_ALLOW
+    assert int(event[0]) == TRACE_TO_LXC
+    assert int(identity[0]) == SERVER_ID  # post-DNAT dst identity
+    assert dp.ct.entry_count() == 1       # CT entry created
+
+
+def test_egress_denied_creates_no_ct():
+    dp = build_dp()
+    pkt = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[22])
+    verdict, event, _ = dp.process(pkt, now=100)
+    assert int(verdict[0]) == VERDICT_DROP
+    assert int(event[0]) == DROP_POLICY
+    assert dp.ct.entry_count() == 0
+
+
+def test_established_bypasses_policy():
+    """After the first allowed packet creates a CT entry, a policy swap
+    to deny does not cut established flows (conntrack fast path)."""
+    dp = build_dp()
+    pkt = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[8080])
+    v, _, _ = dp.process(pkt, now=100)
+    assert int(v[0]) == VERDICT_ALLOW
+    # swap in an empty (deny-all) policy; CT survives the swap
+    dp.load_policy([PolicyMapState()], revision=2)
+    v, _, _ = dp.process(pkt, now=101)
+    assert int(v[0]) == VERDICT_ALLOW  # established
+    # a new flow is now denied
+    pkt2 = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[ipv4_to_u32("10.2.0.5")], sport=[40001], dport=[8080])
+    v, _, _ = dp.process(pkt2, now=102)
+    assert int(v[0]) == VERDICT_DROP
+
+
+def test_proxy_redirect_verdict():
+    dp = build_dp()
+    pkt = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[9090])
+    verdict, event, _ = dp.process(pkt, now=100)
+    assert int(verdict[0]) == 15001
+    assert int(event[0]) == TRACE_TO_PROXY
+
+
+def test_prefilter_beats_everything():
+    dp = build_dp()
+    dp.prefilter.insert(["10.1.0.0/24"])
+    dp.reload_prefilter()
+    pkt = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[8080])
+    verdict, event, _ = dp.process(pkt, now=100)
+    assert int(verdict[0]) == VERDICT_DROP
+    assert int(event[0]) == DROP_PREFILTER
+    assert dp.ct.entry_count() == 0
+
+
+def test_mixed_batch():
+    dp = build_dp()
+    c = ipv4_to_u32("10.1.0.1")
+    s = ipv4_to_u32("10.2.0.5")
+    vip = ipv4_to_u32("10.96.0.10")
+    pkt = make_full_batch(
+        endpoint=[0, 0, 0, 0],
+        saddr=[c, c, c, c],
+        daddr=[vip, s, s, s],
+        sport=[40000, 40001, 40002, 40003],
+        dport=[80, 8080, 22, 9090])
+    verdict, event, _ = dp.process(pkt, now=100)
+    v = np.asarray(verdict)
+    assert v[0] == VERDICT_ALLOW    # via service
+    assert v[1] == VERDICT_ALLOW    # direct allowed port
+    assert v[2] == VERDICT_DROP     # denied port
+    assert v[3] == 15001            # proxy
+    assert dp.ct.entry_count() == 3  # dropped flow not created
+
+
+def test_counters_accumulate():
+    dp = build_dp()
+    pkt = make_full_batch(
+        endpoint=[0] * 8, saddr=[ipv4_to_u32("10.1.0.1")] * 8,
+        daddr=[ipv4_to_u32("10.2.0.5")] * 8,
+        sport=list(range(50000, 50008)), dport=[8080] * 8,
+        length=[200] * 8)
+    dp.process(pkt, now=100)
+    assert int(np.asarray(dp.counters.packets).sum()) == 8
+    assert int(np.asarray(dp.counters.bytes).sum()) == 8 * 200

@@ -1,0 +1,121 @@
+"""Load balancer + prefilter tests (bpf/lib/lb.h, bpf_xdp.c semantics)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from cilium_tpu.compiler.lpm import ipv4_to_u32
+from cilium_tpu.datapath.lb import Backend, LoadBalancer, Service
+from cilium_tpu.datapath.prefilter import PreFilter
+
+
+def as_i32(vals):
+    return jnp.asarray(np.asarray(vals, np.uint32).view(np.int32))
+
+
+def test_lb_dnat_and_distribution():
+    lb = LoadBalancer()
+    vip = ipv4_to_u32("10.96.0.1")
+    backends = [Backend(addr=ipv4_to_u32(f"10.0.0.{i}"), port=8080)
+                for i in range(1, 5)]
+    lb.upsert_service(Service(vip=vip, port=80, backends=backends))
+
+    n = 4096
+    rng = np.random.default_rng(0)
+    daddr = as_i32(np.full(n, vip, np.uint32))
+    dport = jnp.asarray(np.full(n, 80, np.int32))
+    proto = jnp.asarray(np.full(n, 6, np.int32))
+    saddr = as_i32(rng.integers(0, 2**32, n, dtype=np.uint32))
+    sport = jnp.asarray(rng.integers(1024, 65536, n, dtype=np.int32))
+
+    new_daddr, new_dport, rev_nat, is_svc = lb.step(daddr, dport, proto,
+                                                    saddr, sport)
+    assert bool(is_svc.all())
+    assert (np.asarray(new_dport) == 8080).all()
+    # all outputs are backends; distribution roughly uniform
+    chosen = np.asarray(new_daddr).view(np.uint32)
+    allowed = {b.addr for b in backends}
+    assert set(chosen.tolist()) <= allowed
+    counts = np.bincount([list(sorted(allowed)).index(c) for c in chosen])
+    assert counts.min() > n / len(allowed) * 0.7
+
+    # same 5-tuple -> same backend (deterministic selection)
+    nd2, _, _, _ = lb.step(daddr, dport, proto, saddr, sport)
+    np.testing.assert_array_equal(np.asarray(new_daddr), np.asarray(nd2))
+
+
+def test_lb_non_service_passthrough():
+    lb = LoadBalancer()
+    lb.upsert_service(Service(vip=ipv4_to_u32("10.96.0.1"), port=80,
+                              backends=[Backend(ipv4_to_u32("10.0.0.1"),
+                                                8080)]))
+    daddr = as_i32([ipv4_to_u32("8.8.8.8")])
+    nd, ndp, rn, is_svc = lb.step(daddr, jnp.asarray([80]),
+                                  jnp.asarray([6]), daddr,
+                                  jnp.asarray([1000]))
+    assert not bool(is_svc.any())
+    assert int(rn[0]) == 0
+    np.testing.assert_array_equal(np.asarray(nd), np.asarray(daddr))
+
+
+def test_lb_rev_nat_restores_vip():
+    lb = LoadBalancer()
+    vip = ipv4_to_u32("10.96.0.1")
+    lb.upsert_service(Service(vip=vip, port=80,
+                              backends=[Backend(ipv4_to_u32("10.0.0.1"),
+                                                8080)]))
+    # reply from backend: restore VIP using rev_nat index 1
+    saddr, sport = lb.rev_nat(
+        as_i32([ipv4_to_u32("10.0.0.1")]),
+        jnp.asarray(np.asarray([8080], np.int32)),
+        jnp.asarray(np.asarray([1], np.int32)))
+    assert np.asarray(saddr).view(np.uint32)[0] == vip
+    assert int(sport[0]) == 80
+
+
+def test_lb_delete_service():
+    lb = LoadBalancer()
+    vip = ipv4_to_u32("10.96.0.1")
+    lb.upsert_service(Service(vip=vip, port=80,
+                              backends=[Backend(ipv4_to_u32("10.0.0.1"),
+                                                8080)]))
+    assert lb.delete_service(vip, 80)
+    assert not lb.delete_service(vip, 80)
+    _, _, _, is_svc = lb.step(as_i32([vip]), jnp.asarray([80]),
+                              jnp.asarray([6]), as_i32([vip]),
+                              jnp.asarray([1000]))
+    assert not bool(is_svc.any())
+
+
+def test_prefilter_drop_mask():
+    pf = PreFilter()
+    pf.insert(["203.0.113.0/24", "198.51.100.0/24"])
+    addrs = as_i32([ipv4_to_u32("203.0.113.7"),
+                    ipv4_to_u32("8.8.8.8"),
+                    ipv4_to_u32("198.51.100.255")])
+    mask = np.asarray(pf.drop_mask(addrs))
+    np.testing.assert_array_equal(mask, [True, False, True])
+
+    cidrs, rev = pf.dump()
+    assert "203.0.113.0/24" in cidrs and rev >= 2
+
+    pf.delete(["203.0.113.0/24"])
+    mask = np.asarray(pf.drop_mask(addrs))
+    np.testing.assert_array_equal(mask, [False, False, True])
+
+
+def test_prefilter_delete_missing_raises():
+    pf = PreFilter()
+    pf.insert(["203.0.113.0/24"])
+    try:
+        pf.delete(["1.2.3.0/24"])
+        assert False, "expected KeyError"
+    except KeyError:
+        pass
+    # set unchanged after failed delete
+    assert pf.dump()[0] == ["203.0.113.0/24"]
+
+
+def test_prefilter_empty_no_drops():
+    pf = PreFilter()
+    mask = np.asarray(pf.drop_mask(as_i32([1, 2, 3])))
+    assert not mask.any()
