@@ -73,6 +73,7 @@ class CTState(NamedTuple):
     expires: jnp.ndarray  # absolute seconds
     state: jnp.ndarray    # closing/related bits | rx_flags<<8 | tx_flags<<16
     rev_nat: jnp.ndarray  # rev-NAT index for LB'd flows
+    proxy_port: jnp.ndarray  # L7 redirect port for the flow (0 = none)
 
 
 class CTBatch(NamedTuple):
@@ -93,7 +94,7 @@ def make_ct_state(slots: int) -> CTState:
     # CTState is donated each step).
     z = lambda: jnp.zeros(slots + 1, jnp.int32)
     return CTState(k0=z(), k1=z(), k2=z(), k3=z(), expires=z(), state=z(),
-                   rev_nat=z())
+                   rev_nat=z(), proxy_port=z())
 
 
 def _pack_k2(sport, dport):
@@ -135,15 +136,33 @@ def _lifetime(proto, tcp_flags):
 
 
 def ct_step(ct: CTState, batch: CTBatch, now: jnp.ndarray,
-            create_mask: jnp.ndarray, *, slots: int, max_probe: int
-            ) -> Tuple[jnp.ndarray, jnp.ndarray, CTState]:
+            create_mask: jnp.ndarray,
+            update_mask: Optional[jnp.ndarray] = None,
+            rev_nat_in: Optional[jnp.ndarray] = None,
+            proxy_port_in: Optional[jnp.ndarray] = None,
+            *, slots: int, max_probe: int
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, CTState]:
     """One batched CT pass.
 
     ``create_mask`` [B] bool gates CT_NEW entry creation (the policy
     verdict gate — reference bpf_lxc.c:545 creates only after the
-    verdict allows). Returns (ct_verdict [B] in CT_*, rev_nat [B], ct').
+    verdict allows). ``update_mask`` [B] bool additionally gates
+    hit-entry updates (prefilter-dropped packets must not refresh or
+    tear down live entries). ``rev_nat_in``/``proxy_port_in`` [B] are
+    stored into newly created entries (the reference stores
+    rev_nat_index and proxy_port in ct_state at create —
+    conntrack.h ct_create4, proxy redirect path).
+
+    Returns (ct_verdict [B] in CT_*, rev_nat [B], proxy_port [B], ct').
     """
     sentinel = jnp.int32(slots)  # the no-op scatter target
+    b = batch.saddr.shape[0]
+    if update_mask is None:
+        update_mask = jnp.ones(b, bool)
+    if rev_nat_in is None:
+        rev_nat_in = jnp.zeros(b, jnp.int32)
+    if proxy_port_in is None:
+        proxy_port_in = jnp.zeros(b, jnp.int32)
 
     fwd_k0, fwd_k1 = batch.saddr, batch.daddr
     fwd_k2 = _pack_k2(batch.sport, batch.dport)
@@ -170,6 +189,10 @@ def ct_step(ct: CTState, batch: CTBatch, now: jnp.ndarray,
     hit = rfound | ffound
     slot = jnp.where(rfound, rslot, fslot)
     rev_nat = jnp.where(hit, ct.rev_nat[slot], jnp.int32(0))
+    # Established flows keep redirecting through their recorded proxy
+    # port (the reference keeps ct_state.proxy_port so L7 enforcement
+    # covers the whole connection, not just its first packet).
+    proxy_port = jnp.where(ffound, ct.proxy_port[fslot], jnp.int32(0))
 
     # --- update hit entries -------------------------------------------------
     closing = ((batch.tcp_flags & (TCP_FIN | TCP_RST)) != 0) & \
@@ -186,7 +209,7 @@ def ct_step(ct: CTState, batch: CTBatch, now: jnp.ndarray,
                                     jnp.int32(_TX_CLOSING)),
                           jnp.int32(0))
 
-    upd_slot = jnp.where(hit, slot, sentinel)
+    upd_slot = jnp.where(hit & update_mask.astype(bool), slot, sentinel)
     # Last-write-wins scatter for expiry (close shortens, activity extends;
     # duplicate-slot ordering is unspecified — benign, self-correcting).
     expires = ct.expires.at[upd_slot].set(new_exp, mode="drop")
@@ -197,12 +220,13 @@ def ct_step(ct: CTState, batch: CTBatch, now: jnp.ndarray,
                                       mode="drop")
 
     # --- create new entries -------------------------------------------------
-    create = (~hit) & create_mask.astype(bool)
+    create = (~hit) & create_mask.astype(bool) & update_mask.astype(bool)
     new_state = flag_bits | jnp.where(batch.related != 0,
                                       jnp.int32(_RELATED), jnp.int32(0))
     new_life = now + _lifetime(batch.proto, batch.tcp_flags)
     ct2 = CTState(k0=ct.k0, k1=ct.k1, k2=ct.k2, k3=ct.k3,
-                  expires=expires, state=state, rev_nat=ct.rev_nat)
+                  expires=expires, state=state, rev_nat=ct.rev_nat,
+                  proxy_port=ct.proxy_port)
     # Two rounds: flows that lose a same-batch race for an empty slot
     # re-probe against the updated table and take the next free slot.
     # Residual losses after round 2 are ~(collisions^2 / slots) — the
@@ -224,10 +248,11 @@ def ct_step(ct: CTState, batch: CTBatch, now: jnp.ndarray,
             k3=ct2.k3.at[tgt].set(fwd_k3),
             expires=ct2.expires.at[tgt].set(new_life),
             state=ct2.state.at[tgt].set(new_state),
-            rev_nat=ct2.rev_nat.at[tgt].set(jnp.int32(0)))
+            rev_nat=ct2.rev_nat.at[tgt].set(rev_nat_in),
+            proxy_port=ct2.proxy_port.at[tgt].set(proxy_port_in))
         # Keep the sentinel slot permanently empty.
         ct2 = CTState(*(a.at[sentinel].set(jnp.int32(0)) for a in ct2))
-    return verdict, rev_nat, ct2
+    return verdict, rev_nat, proxy_port, ct2
 
 
 def ct_set_rev_nat(ct: CTState, batch: CTBatch, rev_nat_idx: jnp.ndarray,
@@ -252,7 +277,8 @@ def ct_gc(ct: CTState, now: jnp.ndarray) -> Tuple[CTState, jnp.ndarray]:
     clear = lambda x: jnp.where(dead, jnp.int32(0), x)
     return CTState(k0=clear(ct.k0), k1=clear(ct.k1), k2=clear(ct.k2),
                    k3=clear(ct.k3), expires=clear(ct.expires),
-                   state=clear(ct.state), rev_nat=clear(ct.rev_nat)), \
+                   state=clear(ct.state), rev_nat=clear(ct.rev_nat),
+                   proxy_port=clear(ct.proxy_port)), \
         jnp.sum(dead.astype(jnp.int32))
 
 
@@ -277,7 +303,7 @@ class ConntrackTable:
         b = batch.saddr.shape[0]
         if create_mask is None:
             create_mask = jnp.ones(b, bool)
-        verdict, rev_nat, self.state = self._step(
+        verdict, rev_nat, _proxy, self.state = self._step(
             self.state, batch, jnp.int32(now), create_mask)
         return verdict, rev_nat
 

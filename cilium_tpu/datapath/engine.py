@@ -100,15 +100,16 @@ class Datapath:
 
     # -- the hot path --------------------------------------------------------
 
-    def process(self, pkt: FullPacketBatch, now: Optional[int] = None
-                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-        """Classify a batch. Returns (verdict, event, identity), all [B]."""
+    def process(self, pkt: FullPacketBatch, now: Optional[int] = None):
+        """Classify a batch. Returns (verdict, event, identity, nat) —
+        nat carries the DNAT'd forward tuple and rev-NAT'd reply tuple."""
         if self._step is None:
             raise RuntimeError("no policy loaded")
-        verdict, event, identity, self.ct.state, self.counters = self._step(
+        (verdict, event, identity, nat,
+         self.ct.state, self.counters) = self._step(
             self._tables, self.ct.state, self.counters, pkt,
             jnp.int32(now if now is not None else int(time.time())))
-        return verdict, event, identity
+        return verdict, event, identity, nat
 
     # -- maintenance ---------------------------------------------------------
 

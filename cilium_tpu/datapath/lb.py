@@ -66,15 +66,32 @@ class CompiledLB:
 
 
 def compile_lb(services: Sequence[Service]) -> CompiledLB:
-    """Lower a service list to device tables. rev_nat_index is 1-based
-    (0 == no NAT), matching the reference's lbmap convention."""
+    """Lower a service list to device tables.
+
+    rev_nat_index is 1-based (0 == no NAT) and must be STABLE for the
+    lifetime of a service: conntrack entries deliberately survive table
+    recompiles, so a live flow's stored index has to keep resolving to
+    the same VIP. Callers (LoadBalancer) assign indices; services
+    without one get the next free slot here. The rev-NAT arrays are
+    sized by the max index, so deleted services leave zero rows instead
+    of renumbering survivors (the reference's lbmap RevNAT IDs have the
+    same stability contract).
+    """
     entries = {}
     counts, offsets, revnats = [], [], []
     b_addr, b_port = [], []
-    rev_vip = [0]
-    rev_port = [0]
+    used = {s.rev_nat_index for s in services if s.rev_nat_index > 0}
+    next_free = 1
+    for svc in services:
+        if svc.rev_nat_index <= 0:
+            while next_free in used:
+                next_free += 1
+            svc.rev_nat_index = next_free
+            used.add(next_free)
+    max_idx = max(used, default=0)
+    rev_vip = [0] * (max_idx + 1)
+    rev_port = [0] * (max_idx + 1)
     for i, svc in enumerate(services):
-        svc.rev_nat_index = i + 1
         key = (svc.vip & 0xFFFFFFFF,
                ((svc.port & 0xFFFF) << 16) | ((svc.proto & 0xFF) << 8) | 1)
         entries[key] = i
@@ -84,8 +101,8 @@ def compile_lb(services: Sequence[Service]) -> CompiledLB:
         for b in svc.backends:
             b_addr.append(b.addr & 0xFFFFFFFF)
             b_port.append(b.port)
-        rev_vip.append(svc.vip & 0xFFFFFFFF)
-        rev_port.append(svc.port)
+        rev_vip[svc.rev_nat_index] = svc.vip & 0xFFFFFFFF
+        rev_port[svc.rev_nat_index] = svc.port
     t = build_hash_table(entries) if entries else build_hash_table(
         {(0, 1): 0}, min_slots=8)
     as_i32 = lambda x: jnp.asarray(np.asarray(x, np.uint32).view(np.int32)
@@ -154,9 +171,18 @@ class LoadBalancer:
         self._services: Dict[Tuple[int, int, int], Service] = {}
         self.compiled: Optional[CompiledLB] = None
         self._step = None
+        self._next_rev_nat = 1  # stable, monotonically allocated
 
     def upsert_service(self, svc: Service) -> None:
-        self._services[(svc.vip, svc.port, svc.proto)] = svc
+        key = (svc.vip, svc.port, svc.proto)
+        old = self._services.get(key)
+        if old is not None:
+            # keep the stable rev-NAT index across updates
+            svc.rev_nat_index = old.rev_nat_index
+        else:
+            svc.rev_nat_index = self._next_rev_nat
+            self._next_rev_nat += 1
+        self._services[key] = svc
         self._recompile()
 
     def delete_service(self, vip: int, port: int, proto: int = 6) -> bool:

@@ -118,6 +118,27 @@ class FullPacketBatch(NamedTuple):
     is_fragment: jnp.ndarray
 
 
+class NATResult(NamedTuple):
+    """Post-NAT packet tuple: forward packets carry the DNAT'd
+    destination; reply packets carry the rev-NAT'd (VIP-restored)
+    source. All [B] int32."""
+
+    daddr: jnp.ndarray
+    dport: jnp.ndarray
+    saddr: jnp.ndarray
+    sport: jnp.ndarray
+    rev_nat: jnp.ndarray
+
+
+def lb_rev_nat_arrays(lb_tables, saddr, sport, rev_nat_idx):
+    """Clamp-safe reverse NAT (see lb.lb_rev_nat)."""
+    has = rev_nat_idx > 0
+    n = lb_tables.rev_vip.shape[0]
+    idx = jnp.clip(jnp.where(has, rev_nat_idx, 0), 0, n - 1)
+    return (jnp.where(has, lb_tables.rev_vip[idx], saddr),
+            jnp.where(has, lb_tables.rev_port[idx], sport))
+
+
 class FullTables(NamedTuple):
     datapath: DatapathTables          # policy + ipcache LPM
     lb: LBTables                      # service tables
@@ -185,19 +206,33 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
         tables.datapath.key_id, tables.datapath.key_meta,
         tables.datapath.value, counters, vb, policy_probe)
 
-    # 6. CT step with creation gated on the policy allowing the flow
-    # (bpf_lxc.c:545 ct_create4 after policy_can_egress).
+    # 6. CT step. Creation is gated on the policy allowing the flow
+    # (bpf_lxc.c:545 ct_create4 after policy_can_egress); prefilter-
+    # dropped packets may neither create nor touch live entries; new
+    # entries record the flow's rev-NAT index and proxy port so the
+    # whole connection keeps its NAT and L7 redirect.
     create_ok = (pol_verdict >= 0) & ~pf_hit
-    ct_verdict, ct_rev_nat, ct = ct_step(ct, ctb, now, create_ok,
-                                         slots=ct_slots, max_probe=ct_probe)
+    proxy_in = jnp.maximum(pol_verdict, 0)
+    ct_verdict, ct_rev_nat, ct_proxy, ct = ct_step(
+        ct, ctb, now, create_ok, update_mask=~pf_hit,
+        rev_nat_in=rev_nat, proxy_port_in=proxy_in,
+        slots=ct_slots, max_probe=ct_probe)
 
-    # 7. Final verdict: prefilter drop beats everything; established/
-    # reply flows bypass the policy verdict (conntrack fast path);
+    # 7. Final verdict: prefilter drop beats everything; established
+    # flows follow their CT entry (including its recorded proxy port);
     # CT_NEW flows take the policy verdict.
     established = ct_verdict != CT_NEW
     verdict = jnp.where(
         pf_hit, jnp.int32(VERDICT_DROP),
-        jnp.where(established, jnp.int32(VERDICT_ALLOW), pol_verdict))
+        jnp.where(established, ct_proxy, pol_verdict))
+
+    # 8. Reply-path reverse NAT (lb.h lb4_rev_nat): restore VIP/port on
+    # packets of flows whose CT entry carries a rev-NAT index.
+    from .conntrack import CT_REPLY, CT_RELATED
+    is_reply = (ct_verdict == CT_REPLY) | (ct_verdict == CT_RELATED)
+    rn = jnp.where(is_reply, ct_rev_nat, jnp.int32(0))
+    nat_saddr, nat_sport = lb_rev_nat_arrays(tables.lb, pkt.saddr,
+                                             pkt.sport, rn)
 
     event = jnp.where(
         pf_hit, jnp.int32(DROP_PREFILTER),
@@ -205,4 +240,6 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                   jnp.where(verdict < 0, jnp.int32(DROP_POLICY),
                             jnp.where(verdict > 0, jnp.int32(TRACE_TO_PROXY),
                                       jnp.int32(TRACE_TO_LXC)))))
-    return verdict, event, identity, ct, counters
+    nat = NATResult(daddr=daddr, dport=dport, saddr=nat_saddr,
+                    sport=nat_sport, rev_nat=ct_rev_nat)
+    return verdict, event, identity, nat, ct, counters

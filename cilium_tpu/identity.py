@@ -135,13 +135,13 @@ class IdentityCache(Dict[int, LabelArray]):
     """
 
     @classmethod
-    def snapshot(cls, allocator: "LocalIdentityAllocator") -> "IdentityCache":
+    def snapshot(cls, allocator) -> "IdentityCache":
+        """Works with any allocator exposing ``snapshot_identities()``."""
         cache = cls()
         for num, ident in RESERVED_IDENTITY_CACHE.items():
             cache[num] = ident.label_array
-        with allocator._lock:
-            for ident in allocator._by_id.values():
-                cache[ident.id] = ident.label_array
+        for ident in allocator.snapshot_identities():
+            cache[ident.id] = ident.label_array
         return cache
 
 
@@ -217,6 +217,12 @@ class LocalIdentityAllocator:
         if freed and self._on_change:
             self._on_change("delete", ident)
         return freed
+
+    def snapshot_identities(self) -> List[Identity]:
+        """Point-in-time list of live dynamic identities (the allocator
+        interface consumed by IdentityCache.snapshot)."""
+        with self._lock:
+            return list(self._by_id.values())
 
     def lookup_by_id(self, numeric_id: int) -> Optional[Identity]:
         reserved = look_up_reserved_identity(numeric_id)

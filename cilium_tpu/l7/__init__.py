@@ -1,0 +1,14 @@
+"""L7 policy engines: HTTP, Kafka, DNS/FQDN + pluggable parsers.
+
+The reference splits L7 between Envoy C++ filters (HTTP,
+envoy/cilium_l7policy.cc), an in-agent Go Kafka proxy (pkg/proxy/kafka.go
++ pkg/kafka), FQDN rule rewriting (pkg/fqdn), and the proxylib parser
+framework (proxylib/). Here every matcher compiles to dense tensors
+(DFA tables, key bitmasks) evaluated in batch; the parser framework
+keeps the reference's OnNewConnection/OnData contract for custom
+protocols.
+"""
+
+from .http import HTTPPolicyEngine
+from .kafka import KafkaPolicyEngine, KafkaRequest, parse_kafka_request
+from .dns import DNSCache, DNSPolicyEngine, DNSPoller

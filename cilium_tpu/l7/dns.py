@@ -1,0 +1,185 @@
+"""DNS / FQDN policy: TTL cache, poller, rule injection, batched matching.
+
+Reference: pkg/fqdn — ``ToFQDNs`` egress rules are realized by resolving
+matchNames on an interval (dnspoller.go:50, 5s), caching responses with
+TTL awareness (cache.go:91), and rewriting the rules with generated
+``ToCIDRSet`` entries (helpers.go:45) that re-enter the policy import
+path. The DNS-proxy-side question "is this name allowed?" is answered
+here by a compiled DFA over all FQDN selectors, matched in batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..compiler.regexc import compile_regex_set
+from ..ops.dfa_ops import dfa_match, encode_strings
+from ..policy.api import CIDRRule, FQDNSelector, Rule
+
+DNS_POLLER_INTERVAL = 5.0  # reference: dnspoller.go:50 (5s)
+MAX_NAME_LEN = 255
+
+
+def _canon(name: str) -> str:
+    return name.lower().rstrip(".")
+
+
+class DNSCache:
+    """TTL-aware name -> IPs cache (reference: pkg/fqdn/cache.go:91)."""
+
+    def __init__(self, min_ttl: int = 0):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Dict[str, float]] = {}  # name -> ip -> exp
+        self.min_ttl = min_ttl
+
+    def update(self, name: str, ips: Sequence[str], ttl: int,
+               now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        exp = now + max(ttl, self.min_ttl)
+        with self._lock:
+            m = self._entries.setdefault(_canon(name), {})
+            for ip in ips:
+                m[ip] = max(m.get(ip, 0), exp)
+
+    def lookup(self, name: str, now: Optional[float] = None) -> List[str]:
+        now = time.time() if now is None else now
+        with self._lock:
+            m = self._entries.get(_canon(name), {})
+            return sorted(ip for ip, exp in m.items() if exp > now)
+
+    def gc(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        removed = 0
+        with self._lock:
+            for name in list(self._entries):
+                m = self._entries[name]
+                for ip in list(m):
+                    if m[ip] <= now:
+                        del m[ip]
+                        removed += 1
+                if not m:
+                    del self._entries[name]
+        return removed
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+
+class DNSPolicyEngine:
+    """Batched "is this observed DNS name allowed?" matcher over all
+    FQDN selectors (the DNS-proxy enforcement point)."""
+
+    def __init__(self, selectors: Sequence[FQDNSelector]):
+        self.selectors = list(selectors)
+        self._compiled = compile_regex_set(
+            [s.to_regex() for s in self.selectors]) if self.selectors \
+            else None
+
+    def match(self, names: Sequence[str]) -> np.ndarray:
+        """[B, R] selector hits for a batch of names."""
+        if self._compiled is None:
+            return np.zeros((len(names), 0), bool)
+        data = jnp.asarray(encode_strings([_canon(n) for n in names],
+                                          MAX_NAME_LEN))
+        return np.asarray(dfa_match(jnp.asarray(self._compiled.table),
+                                    jnp.asarray(self._compiled.accept),
+                                    jnp.asarray(self._compiled.starts),
+                                    data))
+
+    def allowed(self, names: Sequence[str]) -> np.ndarray:
+        hits = self.match(names)
+        if hits.shape[1] == 0:
+            return np.zeros(len(names), bool)
+        return hits.any(axis=1)
+
+
+def inject_to_cidr_set(rule: Rule, cache: DNSCache,
+                       now: Optional[float] = None) -> bool:
+    """Rewrite a rule's ToFQDNs egress into generated ToCIDRSet entries
+    from cached resolutions (reference: pkg/fqdn/helpers.go:45
+    injectToCIDRSetRules). Returns True if any CIDR was injected."""
+    changed = False
+    for eg in rule.egress:
+        if not eg.to_fqdns:
+            continue
+        cidrs: List[CIDRRule] = []
+        for sel in eg.to_fqdns:
+            if sel.match_name:
+                for ip in cache.lookup(sel.match_name, now):
+                    suffix = "/32" if ":" not in ip else "/128"
+                    cidrs.append(CIDRRule(cidr=ip + suffix, generated=True))
+            elif sel.match_pattern:
+                for name in cache.names():
+                    if sel.matches(name):
+                        for ip in cache.lookup(name, now):
+                            suffix = "/32" if ":" not in ip else "/128"
+                            cidrs.append(CIDRRule(cidr=ip + suffix,
+                                                  generated=True))
+        eg.to_cidr_set = cidrs
+        changed = changed or bool(cidrs)
+    return changed
+
+
+class DNSPoller:
+    """Periodic matchName resolution driving rule re-injection
+    (reference: pkg/fqdn/dnspoller.go — StartDNSPoller loop + config
+    LookupDNSNames hook)."""
+
+    def __init__(self, cache: DNSCache,
+                 lookup: Callable[[List[str]], Dict[str, Tuple[List[str], int]]],
+                 on_change: Optional[Callable[[Set[str]], None]] = None,
+                 interval: float = DNS_POLLER_INTERVAL):
+        self.cache = cache
+        self.lookup = lookup       # names -> {name: (ips, ttl)}
+        self.on_change = on_change
+        self.interval = interval
+        self._names: Set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register_rule(self, rule: Rule) -> None:
+        with self._lock:
+            for eg in rule.egress:
+                for sel in eg.to_fqdns:
+                    if sel.match_name:
+                        self._names.add(_canon(sel.match_name))
+
+    def poll_once(self, now: Optional[float] = None) -> Set[str]:
+        """One poll cycle; returns names whose IP set changed."""
+        with self._lock:
+            names = sorted(self._names)
+        if not names:
+            return set()
+        before = {n: tuple(self.cache.lookup(n, now)) for n in names}
+        results = self.lookup(names)
+        for name, (ips, ttl) in results.items():
+            self.cache.update(name, ips, ttl, now)
+        changed = {n for n in names
+                   if tuple(self.cache.lookup(n, now)) != before[n]}
+        if changed and self.on_change:
+            self.on_change(changed)
+        return changed
+
+    def start(self) -> None:
+        def run():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.poll_once()
+                except Exception:   # resolver failures must not kill the loop
+                    pass
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)

@@ -1,0 +1,111 @@
+"""HTTP L7 policy: batched request matching via compiled DFAs.
+
+Semantics: a request is allowed iff ANY rule in the per-identity rule
+set matches; a rule matches iff its method/path/host regexes all match
+(anchored) and all its required headers are present (with value when
+given). Reference: pkg/policy/api/http.go:28 +
+envoy/cilium_network_policy.h:90-111 (PortNetworkPolicyRule::Matches
+over HeaderMatcher regexes) + envoy/cilium_l7policy.cc:127.
+
+Compilation: method/path/host collapse into ONE regex per rule over the
+combined string ``method \\x00 path \\x00 host`` so the whole rule set is
+R DFAs advanced together; headers compile to per-requirement DFAs over a
+canonical ``\\x01name: value\\x01...`` block.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..compiler.regexc import CompiledRegexSet, compile_regex_set
+from ..ops.dfa_ops import dfa_match, encode_strings
+from ..policy.api import PortRuleHTTP
+
+MAX_REQUEST_LINE = 512
+MAX_HEADER_BLOCK = 1024
+
+
+def _rule_to_combined_regex(rule: PortRuleHTTP) -> str:
+    m = rule.method if rule.method else "[^\\x00]*"
+    p = rule.path if rule.path else "[^\\x00]*"
+    h = rule.host if rule.host else "[^\\x00]*"
+    return f"(?:{m})\\x00(?:{p})\\x00(?:{h})"
+
+
+def _header_regex(header: str) -> str:
+    name, sep, want = header.partition(" ")
+    name_re = "".join(
+        f"[{c.lower()}{c.upper()}]" if c.isalpha() else
+        ("\\" + c if c in ".+*?()[]{}^$|\\" else c)
+        for c in name)
+    if sep and want:
+        esc = "".join("\\" + c if c in ".+*?()[]{}^$|\\" else c
+                      for c in want)
+        return f".*\\x01{name_re}: {esc}\\x01.*"
+    return f".*\\x01{name_re}: [^\\x01]*\\x01.*"
+
+
+@dataclass
+class HTTPRequest:
+    method: str
+    path: str
+    host: str = ""
+    headers: Optional[Dict[str, str]] = None
+
+
+class HTTPPolicyEngine:
+    """One compiled HTTP rule set (one proxy redirect's policy)."""
+
+    def __init__(self, rules: Sequence[PortRuleHTTP]):
+        self.rules = list(rules)
+        if not self.rules:
+            # empty rule set == L7 allow-all (wildcarded redirect)
+            self._combined = None
+            return
+        self._combined = compile_regex_set(
+            [_rule_to_combined_regex(r) for r in self.rules])
+        header_patterns: List[str] = []
+        self._header_slices: List[Tuple[int, int]] = []
+        for r in self.rules:
+            start = len(header_patterns)
+            header_patterns.extend(_header_regex(h) for h in r.headers)
+            self._header_slices.append((start, len(header_patterns)))
+        self._headers = compile_regex_set(header_patterns) \
+            if header_patterns else None
+
+    def check(self, requests: Sequence[HTTPRequest]) -> np.ndarray:
+        """Batched verdicts: [B] bool (True == allow)."""
+        if self._combined is None:
+            return np.ones(len(requests), bool)
+        lines = [f"{r.method}\x00{r.path}\x00{(r.host or '').lower()}"
+                 for r in requests]
+        data = jnp.asarray(encode_strings(lines, MAX_REQUEST_LINE))
+        rule_hit = np.array(dfa_match(
+            jnp.asarray(self._combined.table),
+            jnp.asarray(self._combined.accept),
+            jnp.asarray(self._combined.starts), data))      # [B, R]
+
+        if self._headers is not None:
+            blocks = []
+            for r in requests:
+                hdrs = r.headers or {}
+                canon = "\x01".join(f"{k.lower()}: {v}"
+                                    for k, v in sorted(hdrs.items()))
+                blocks.append("\x01" + canon + "\x01")
+            hdata = jnp.asarray(encode_strings(blocks, MAX_HEADER_BLOCK))
+            hdr_hit = np.asarray(dfa_match(
+                jnp.asarray(self._headers.table),
+                jnp.asarray(self._headers.accept),
+                jnp.asarray(self._headers.starts), hdata))  # [B, H]
+            for ri, (s, e) in enumerate(self._header_slices):
+                if e > s:
+                    rule_hit[:, ri] &= hdr_hit[:, s:e].all(axis=1)
+        return rule_hit.any(axis=1)
+
+    def check_one(self, request: HTTPRequest) -> bool:
+        return bool(self.check([request])[0])

@@ -1,0 +1,69 @@
+"""Batched DFA evaluation: byte-stream scan over stacked transition tables.
+
+The L7 hot loop: advance [B, R] DFA states over [B, L] payload bytes with
+one gather per byte position (``lax.scan`` over the length axis). State
+is carried in/out, so long payloads stream through in chunks with the
+state vector as the carry — the blockwise/"ring" treatment of the
+sequence dimension (SURVEY.md §2.8: streaming L7 byte-stream parsing is
+this domain's long-sequence axis).
+
+Padding convention: byte -1 marks end-of-input; states freeze there.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dfa_scan(table: jnp.ndarray, states: jnp.ndarray,
+             data: jnp.ndarray) -> jnp.ndarray:
+    """Advance DFA states over byte columns.
+
+    table: [S, 256] int32; states: [B, R] int32 (current states);
+    data: [B, L] int32 bytes in [0,255], or -1 for padding.
+    Returns final states [B, R].
+    """
+    flat = table.reshape(-1)          # [S*256]
+    b, r = states.shape
+
+    def step(st, col):
+        # col: [B]; st: [B, R]
+        valid = col >= 0
+        idx = st * jnp.int32(256) + jnp.where(valid, col, 0)[:, None]
+        nxt = flat[idx]               # [B, R] — 2-D gather (fast path)
+        return jnp.where(valid[:, None], nxt, st), None
+
+    final, _ = lax.scan(step, states, data.T)  # scan over L
+    return final
+
+
+def dfa_match(table: jnp.ndarray, accept: jnp.ndarray, starts: jnp.ndarray,
+              data: jnp.ndarray) -> jnp.ndarray:
+    """One-shot anchored match of every regex against every row.
+
+    data: [B, L] padded bytes. Returns accept mask [B, R].
+    """
+    b = data.shape[0]
+    states = jnp.broadcast_to(starts[None, :], (b, starts.shape[0]))
+    final = dfa_scan(table, states.astype(jnp.int32), data)
+    ok = accept[final]
+    # Rows poisoned as overlong (-2 fill from encode_strings) never match.
+    overlong = jnp.any(data == -2, axis=1)
+    return ok & ~overlong[:, None]
+
+
+def encode_strings(strings, length: int) -> "np.ndarray":
+    """Host helper: pad/truncate byte strings to an [B, L] int32 block."""
+    import numpy as np
+    out = np.full((len(strings), length), -1, np.int32)
+    for i, s in enumerate(strings):
+        bs = s.encode() if isinstance(s, str) else bytes(s)
+        n = min(len(bs), length)
+        out[i, :n] = np.frombuffer(bs[:n], np.uint8)
+        if len(bs) > length:
+            out[i, :] = -2  # overlong: poison so nothing matches
+    return out

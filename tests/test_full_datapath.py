@@ -48,7 +48,7 @@ def test_egress_allowed_via_service_vip():
     pkt = make_full_batch(
         endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
         daddr=[ipv4_to_u32("10.96.0.10")], sport=[40000], dport=[80])
-    verdict, event, identity = dp.process(pkt, now=100)
+    verdict, event, identity, nat = dp.process(pkt, now=100)
     assert int(verdict[0]) == VERDICT_ALLOW
     assert int(event[0]) == TRACE_TO_LXC
     assert int(identity[0]) == SERVER_ID  # post-DNAT dst identity
@@ -60,7 +60,7 @@ def test_egress_denied_creates_no_ct():
     pkt = make_full_batch(
         endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
         daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[22])
-    verdict, event, _ = dp.process(pkt, now=100)
+    verdict, event, _, _ = dp.process(pkt, now=100)
     assert int(verdict[0]) == VERDICT_DROP
     assert int(event[0]) == DROP_POLICY
     assert dp.ct.entry_count() == 0
@@ -73,17 +73,17 @@ def test_established_bypasses_policy():
     pkt = make_full_batch(
         endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
         daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[8080])
-    v, _, _ = dp.process(pkt, now=100)
+    v, _, _, _ = dp.process(pkt, now=100)
     assert int(v[0]) == VERDICT_ALLOW
     # swap in an empty (deny-all) policy; CT survives the swap
     dp.load_policy([PolicyMapState()], revision=2)
-    v, _, _ = dp.process(pkt, now=101)
+    v, _, _, _ = dp.process(pkt, now=101)
     assert int(v[0]) == VERDICT_ALLOW  # established
     # a new flow is now denied
     pkt2 = make_full_batch(
         endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
         daddr=[ipv4_to_u32("10.2.0.5")], sport=[40001], dport=[8080])
-    v, _, _ = dp.process(pkt2, now=102)
+    v, _, _, _ = dp.process(pkt2, now=102)
     assert int(v[0]) == VERDICT_DROP
 
 
@@ -92,7 +92,7 @@ def test_proxy_redirect_verdict():
     pkt = make_full_batch(
         endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
         daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[9090])
-    verdict, event, _ = dp.process(pkt, now=100)
+    verdict, event, _, _ = dp.process(pkt, now=100)
     assert int(verdict[0]) == 15001
     assert int(event[0]) == TRACE_TO_PROXY
 
@@ -104,7 +104,7 @@ def test_prefilter_beats_everything():
     pkt = make_full_batch(
         endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
         daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[8080])
-    verdict, event, _ = dp.process(pkt, now=100)
+    verdict, event, _, _ = dp.process(pkt, now=100)
     assert int(verdict[0]) == VERDICT_DROP
     assert int(event[0]) == DROP_PREFILTER
     assert dp.ct.entry_count() == 0
@@ -121,7 +121,7 @@ def test_mixed_batch():
         daddr=[vip, s, s, s],
         sport=[40000, 40001, 40002, 40003],
         dport=[80, 8080, 22, 9090])
-    verdict, event, _ = dp.process(pkt, now=100)
+    verdict, event, _, _ = dp.process(pkt, now=100)
     v = np.asarray(verdict)
     assert v[0] == VERDICT_ALLOW    # via service
     assert v[1] == VERDICT_ALLOW    # direct allowed port
@@ -140,3 +140,92 @@ def test_counters_accumulate():
     dp.process(pkt, now=100)
     assert int(np.asarray(dp.counters.packets).sum()) == 8
     assert int(np.asarray(dp.counters.bytes).sum()) == 8 * 200
+
+
+# --- review regressions -----------------------------------------------------
+
+def test_established_flow_keeps_proxy_redirect():
+    """Every packet of a proxied flow must keep redirecting to the proxy
+    port recorded in its CT entry, not just the first one (the reference
+    stores proxy_port in ct_state)."""
+    dp = build_dp()
+    pkt = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[9090])
+    v1, _, _, _ = dp.process(pkt, now=100)
+    assert int(v1[0]) == 15001
+    v2, e2, _, _ = dp.process(pkt, now=101)
+    assert int(v2[0]) == 15001  # established, still redirected
+    assert int(e2[0]) == TRACE_TO_PROXY
+
+
+def test_prefilter_drop_does_not_touch_ct():
+    """A denylisted source's spoofed RST must not tear down a live CT
+    entry (update_mask gating)."""
+    dp = build_dp()
+    pkt = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[8080])
+    v, _, _, _ = dp.process(pkt, now=100)
+    assert int(v[0]) == VERDICT_ALLOW
+    # now denylist the source and send an RST on the same tuple
+    dp.prefilter.insert(["10.1.0.0/24"])
+    dp.reload_prefilter()
+    rst = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[ipv4_to_u32("10.2.0.5")], sport=[40000], dport=[8080],
+        tcp_flags=[0x04])  # RST
+    v, e, _, _ = dp.process(rst, now=101)
+    assert int(v[0]) == VERDICT_DROP and int(e[0]) == DROP_PREFILTER
+    # the entry is still alive well past the close timeout
+    dp.prefilter.delete(["10.1.0.0/24"])
+    dp.reload_prefilter()
+    dp.load_policy([PolicyMapState()], revision=3)  # deny-all for new flows
+    v, _, _, _ = dp.process(pkt, now=150)
+    assert int(v[0]) == VERDICT_ALLOW  # still established
+
+
+def test_reply_rev_nat_restores_vip():
+    """A backend's reply gets its source rewritten back to the VIP via
+    the rev-NAT index recorded at CT create."""
+    dp = build_dp()
+    vip = ipv4_to_u32("10.96.0.10")
+    fwd = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.1.0.1")],
+        daddr=[vip], sport=[40000], dport=[80])
+    v, _, _, nat = dp.process(fwd, now=100)
+    assert int(v[0]) == VERDICT_ALLOW
+    assert np.asarray(nat.daddr).view(np.uint32)[0] == ipv4_to_u32("10.2.0.5")
+    assert int(nat.dport[0]) == 8080
+    # reply from the backend (ingress direction, reversed tuple)
+    reply = make_full_batch(
+        endpoint=[0], saddr=[ipv4_to_u32("10.2.0.5")],
+        daddr=[ipv4_to_u32("10.1.0.1")], sport=[8080], dport=[40000],
+        direction=[0], tcp_flags=[0x12])
+    v, _, _, nat = dp.process(reply, now=101)
+    assert int(v[0]) == VERDICT_ALLOW  # reply of established flow
+    assert np.asarray(nat.saddr).view(np.uint32)[0] == vip
+    assert int(nat.sport[0]) == 80
+
+
+def test_lb_rev_nat_index_stable_across_delete():
+    """Deleting one service must not renumber others' rev-NAT indices."""
+    from cilium_tpu.datapath.lb import LoadBalancer
+    lb = LoadBalancer()
+    vip_a, vip_b = ipv4_to_u32("10.96.0.1"), ipv4_to_u32("10.96.0.2")
+    lb.upsert_service(Service(vip=vip_a, port=80,
+                              backends=[Backend(ipv4_to_u32("10.0.0.1"),
+                                                8080)]))
+    lb.upsert_service(Service(vip=vip_b, port=81,
+                              backends=[Backend(ipv4_to_u32("10.0.0.2"),
+                                                8081)]))
+    idx_b = lb._services[(vip_b, 81, 6)].rev_nat_index
+    lb.delete_service(vip_a, 80)
+    assert lb._services[(vip_b, 81, 6)].rev_nat_index == idx_b
+    # the rev table still maps idx_b -> vip_b
+    saddr, sport = lb.rev_nat(
+        jnp.asarray(np.asarray([0], np.int32)),
+        jnp.asarray(np.asarray([1], np.int32)),
+        jnp.asarray(np.asarray([idx_b], np.int32)))
+    assert np.asarray(saddr).view(np.uint32)[0] == vip_b
+    assert int(sport[0]) == 81

@@ -1,0 +1,251 @@
+"""L7 engine tests: HTTP, Kafka (wire + ACL), DNS/FQDN, parser framework,
+proxy manager (mirrors reference pkg/kafka, pkg/fqdn, proxylib tests)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from cilium_tpu.l7.dns import DNSCache, DNSPolicyEngine, DNSPoller
+from cilium_tpu.l7.http import HTTPPolicyEngine, HTTPRequest
+from cilium_tpu.l7.kafka import (KafkaPolicyEngine, KafkaRequest,
+                                 parse_kafka_request)
+from cilium_tpu.l7.parser import (Connection, Instance, LineParser, Op,
+                                  REGISTRY)
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.policy.api import (FQDNSelector, PortRuleHTTP, PortRuleKafka,
+                                   PortRuleL7, Rule, EgressRule,
+                                   EndpointSelector)
+from cilium_tpu.policy.l4 import L4Filter, L7DataMap, PARSER_TYPE_HTTP
+from cilium_tpu.policy.api import L7Rules, WILDCARD_SELECTOR
+from cilium_tpu.proxy import ProxyManager, proxy_id
+
+
+# --- HTTP -------------------------------------------------------------------
+
+def test_http_engine_method_path():
+    eng = HTTPPolicyEngine([
+        PortRuleHTTP(method="GET", path="/public/.*"),
+        PortRuleHTTP(method="POST", path="/upload"),
+    ])
+    reqs = [HTTPRequest("GET", "/public/a.html"),
+            HTTPRequest("GET", "/private/a"),
+            HTTPRequest("POST", "/upload"),
+            HTTPRequest("PUT", "/upload")]
+    v = eng.check(reqs)
+    np.testing.assert_array_equal(v, [True, False, True, False])
+
+
+def test_http_engine_host_and_headers():
+    eng = HTTPPolicyEngine([
+        PortRuleHTTP(method="GET", host=".*\\.example\\.com",
+                     headers=("X-Token secret",)),
+    ])
+    ok = eng.check_one(HTTPRequest("GET", "/x", host="api.example.com",
+                                   headers={"X-Token": "secret"}))
+    assert ok
+    assert not eng.check_one(HTTPRequest("GET", "/x", host="api.example.com",
+                                         headers={"X-Token": "wrong"}))
+    assert not eng.check_one(HTTPRequest("GET", "/x", host="evil.com",
+                                         headers={"X-Token": "secret"}))
+    assert not eng.check_one(HTTPRequest("GET", "/x",
+                                         host="api.example.com"))
+
+
+def test_http_empty_rules_allow_all():
+    eng = HTTPPolicyEngine([])
+    assert eng.check_one(HTTPRequest("DELETE", "/anything"))
+
+
+def test_http_empty_rule_matches_everything():
+    eng = HTTPPolicyEngine([PortRuleHTTP()])
+    assert eng.check_one(HTTPRequest("PATCH", "/whatever", host="x"))
+
+
+# --- Kafka ------------------------------------------------------------------
+
+def _kafka_frame(api_key, version, client_id, body=b""):
+    hdr = struct.pack(">hhi", api_key, version, 1)
+    cid = struct.pack(">h", len(client_id)) + client_id.encode()
+    payload = hdr + cid + body
+    return struct.pack(">i", len(payload)) + payload
+
+
+def _metadata_req(topics, client_id="cli"):
+    body = struct.pack(">i", len(topics))
+    for t in topics:
+        body += struct.pack(">h", len(t)) + t.encode()
+    return _kafka_frame(3, 0, client_id, body)
+
+
+def _produce_req(topic, client_id="cli"):
+    body = struct.pack(">hi", 1, 1000)  # acks, timeout
+    body += struct.pack(">i", 1)
+    body += struct.pack(">h", len(topic)) + topic.encode()
+    return _kafka_frame(0, 0, client_id, body)
+
+
+def test_kafka_parse():
+    req = parse_kafka_request(_metadata_req(["logs", "events"]))
+    assert req.api_key == 3
+    assert req.client_id == "cli"
+    assert req.topics == ["logs", "events"]
+    req = parse_kafka_request(_produce_req("logs"))
+    assert req.api_key == 0 and req.topics == ["logs"]
+
+
+def test_kafka_acl_topic():
+    eng = KafkaPolicyEngine([PortRuleKafka(api_key="produce", topic="logs")])
+    assert eng.allows(parse_kafka_request(_produce_req("logs")))
+    assert not eng.allows(parse_kafka_request(_produce_req("secret")))
+    # fetch not allowed by produce-key rule
+    eng2 = KafkaPolicyEngine([PortRuleKafka(role="produce", topic="logs")])
+    # produce role includes metadata + apiversions
+    assert eng2.allows(parse_kafka_request(_metadata_req(["logs"])))
+    assert not eng2.allows(parse_kafka_request(_metadata_req(["other"])))
+
+
+def test_kafka_all_topics_must_be_allowed():
+    """MatchesRule: every topic in the request needs a covering rule."""
+    eng = KafkaPolicyEngine([
+        PortRuleKafka(topic="a"), PortRuleKafka(topic="b")])
+    assert eng.allows(parse_kafka_request(_metadata_req(["a"])))
+    assert eng.allows(parse_kafka_request(_metadata_req(["a", "b"])))
+    assert not eng.allows(parse_kafka_request(_metadata_req(["a", "c"])))
+
+
+def test_kafka_client_id_and_version():
+    eng = KafkaPolicyEngine([PortRuleKafka(client_id="good")])
+    assert eng.allows(parse_kafka_request(_metadata_req([], "good")))
+    assert not eng.allows(parse_kafka_request(_metadata_req([], "evil")))
+    eng = KafkaPolicyEngine([PortRuleKafka(api_version="0")])
+    assert eng.allows(parse_kafka_request(_metadata_req([])))
+    eng = KafkaPolicyEngine([PortRuleKafka(api_version="5")])
+    assert not eng.allows(parse_kafka_request(_metadata_req([])))
+
+
+def test_kafka_empty_rules_allow():
+    assert KafkaPolicyEngine([]).allows(
+        parse_kafka_request(_metadata_req(["x"])))
+
+
+# --- DNS / FQDN -------------------------------------------------------------
+
+def test_dns_cache_ttl():
+    c = DNSCache()
+    c.update("cilium.io", ["1.2.3.4"], ttl=60, now=100)
+    assert c.lookup("cilium.io", now=120) == ["1.2.3.4"]
+    assert c.lookup("CILIUM.IO.", now=120) == ["1.2.3.4"]  # canonical
+    assert c.lookup("cilium.io", now=161) == []
+    assert c.gc(now=161) == 1
+
+
+def test_dns_policy_engine():
+    eng = DNSPolicyEngine([FQDNSelector(match_name="cilium.io"),
+                           FQDNSelector(match_pattern="*.corp.net")])
+    allowed = eng.allowed(["cilium.io", "a.corp.net", "evil.com",
+                           "x.y.corp.net"])
+    np.testing.assert_array_equal(allowed, [True, True, False, False])
+
+
+def test_dns_poller_and_injection():
+    cache = DNSCache()
+    rule = Rule(endpoint_selector=EndpointSelector.parse("app"),
+                egress=[EgressRule(
+                    to_fqdns=[FQDNSelector(match_name="svc.example.com")])])
+    changes = []
+    poller = DNSPoller(
+        cache,
+        lookup=lambda names: {n: (["10.5.5.5"], 300) for n in names},
+        on_change=lambda names: changes.append(names))
+    poller.register_rule(rule)
+    changed = poller.poll_once(now=100)
+    assert changed == {"svc.example.com"}
+    assert changes == [{"svc.example.com"}]
+
+    from cilium_tpu.l7.dns import inject_to_cidr_set
+    assert inject_to_cidr_set(rule, cache, now=100)
+    assert rule.egress[0].to_cidr_set[0].cidr == "10.5.5.5/32"
+    assert rule.egress[0].to_cidr_set[0].generated
+
+    # second poll with same results: no change
+    assert poller.poll_once(now=101) == set()
+
+
+# --- parser framework -------------------------------------------------------
+
+def test_line_parser_policy():
+    inst = Instance()
+    assert inst.on_new_connection(
+        "line", 1, ingress=True, src_id=100, dst_id=200,
+        l7_rules=[PortRuleL7.from_dict({"cmd": "GET"})])
+    ops = inst.on_data(1, reply=False, end_stream=False,
+                       data=b"GET x\nPUT y\nGET z\n")
+    assert [(o.op, o.n) for o in ops] == [
+        (Op.PASS, 6), (Op.DROP, 6), (Op.PASS, 6)]
+    inst.close(1)
+    assert len(inst) == 0
+
+
+def test_line_parser_partial_frames():
+    inst = Instance()
+    inst.on_new_connection("line", 2, ingress=False, src_id=1, dst_id=2)
+    ops = inst.on_data(2, reply=False, end_stream=False, data=b"GET par")
+    assert ops[-1].op == Op.MORE
+    # proxy re-presents the whole buffer once more data arrives
+    ops = inst.on_data(2, reply=False, end_stream=False,
+                       data=b"GET partial\n")
+    assert (ops[0].op, ops[0].n) == (Op.PASS, 12)
+
+
+def test_block_parser():
+    inst = Instance()
+    inst.on_new_connection("block", 3, ingress=True, src_id=1, dst_id=2)
+    data = b"0005Hello0003Dxx"
+    ops = inst.on_data(3, reply=False, end_stream=False, data=data)
+    assert [(o.op, o.n) for o in ops] == [(Op.PASS, 9), (Op.DROP, 7)]
+
+
+def test_unknown_protocol_rejected():
+    inst = Instance()
+    assert not inst.on_new_connection("nosuch", 9, ingress=True,
+                                      src_id=1, dst_id=2)
+
+
+# --- proxy manager ----------------------------------------------------------
+
+def _http_filter(port=80):
+    l7map = L7DataMap()
+    l7map[WILDCARD_SELECTOR] = L7Rules(http=[PortRuleHTTP(method="GET")])
+    return L4Filter(port=port, protocol="TCP", u8proto=6,
+                    l7_parser=PARSER_TYPE_HTTP, l7_rules_per_ep=l7map,
+                    ingress=True)
+
+
+def test_proxy_redirect_lifecycle():
+    pm = ProxyManager()
+    flt = _http_filter()
+    r = pm.create_or_update_redirect(flt, endpoint_id=42)
+    assert 10000 <= r.proxy_port <= 20000
+    assert r.id == proxy_id(42, True, "TCP", 80)
+    # same key: same port
+    r2 = pm.create_or_update_redirect(flt, endpoint_id=42)
+    assert r2.proxy_port == r.proxy_port
+    assert len(pm) == 1
+    # different endpoint: new port
+    r3 = pm.create_or_update_redirect(flt, endpoint_id=43)
+    assert r3.proxy_port != r.proxy_port
+    assert pm.remove_redirect(r.id)
+    assert not pm.remove_redirect(r.id)
+
+
+def test_proxy_http_check_and_access_log():
+    pm = ProxyManager()
+    r = pm.create_or_update_redirect(_http_filter(), endpoint_id=1)
+    v = pm.check_http(r, LabelArray.parse_select("whoever"),
+                      [HTTPRequest("GET", "/a"), HTTPRequest("POST", "/a")])
+    np.testing.assert_array_equal(v, [True, False])
+    tail = pm.access_log.tail()
+    assert len(tail) == 2
+    assert tail[0].verdict == "forwarded"
+    assert tail[1].verdict == "denied"

@@ -1,0 +1,100 @@
+"""Regex->DFA compiler tests: differential against Python re.fullmatch
+(the policygen-style oracle matrix for the L7 compiler)."""
+
+import re
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from cilium_tpu.compiler.regexc import (RegexCompileError, compile_regex_set,
+                                        oracle_match)
+from cilium_tpu.ops.dfa_ops import dfa_match, dfa_scan, encode_strings
+
+PATTERNS = [
+    "GET",
+    "GET|POST|PUT",
+    "/public/.*",
+    "/api/v[0-9]+/users/[0-9]+",
+    "foo.?bar",
+    "a+b*c?",
+    "[a-zA-Z_][a-zA-Z0-9_]*",
+    "(ab|cd)+x",
+    "[^/]+/[^/]+",
+    ".*\\.cilium\\.io",
+    "a{2,4}",
+    "x{3}y",
+    "\\d+\\.\\d+",
+    "(GET|HEAD)( /[a-z]*)?",
+]
+
+TEXTS = [
+    "GET", "POST", "PUT", "PATCH", "get",
+    "/public/index.html", "/public/", "/private/x",
+    "/api/v1/users/42", "/api/v12/users/7", "/api/v/users/7",
+    "foobar", "fooxbar", "fooxxbar",
+    "abc", "aabbcc", "ac", "c", "",
+    "hello_world", "9bad", "_ok",
+    "abx", "cdx", "ababx", "abcdx", "x",
+    "foo/bar", "a/b/c",
+    "sub.cilium.io", "cilium.io", "evil.com",
+    "aa", "aaa", "aaaa", "aaaaa",
+    "xxxy", "xxy",
+    "1.5", "12.34", "1,5",
+    "GET /abc", "HEAD", "GET /ABC",
+]
+
+
+def test_dfa_differential_vs_re():
+    compiled = compile_regex_set(PATTERNS)
+    data = jnp.asarray(encode_strings(TEXTS, 64))
+    got = np.asarray(dfa_match(jnp.asarray(compiled.table),
+                               jnp.asarray(compiled.accept),
+                               jnp.asarray(compiled.starts), data))
+    for ti, text in enumerate(TEXTS):
+        for pi, pat in enumerate(PATTERNS):
+            want = re.fullmatch(pat, text) is not None
+            assert got[ti, pi] == want, (pat, text, bool(got[ti, pi]), want)
+
+
+def test_dfa_streaming_chunks_match_oneshot():
+    """State carried across chunk boundaries must equal one-shot eval —
+    the blockwise sequence dimension."""
+    compiled = compile_regex_set(["/api/v[0-9]+/.*", "GET|PUT"])
+    texts = ["/api/v42/some/long/path/xyz", "GET", "/api/vv/x"]
+    L = 32
+    data = encode_strings(texts, L)
+    one = np.asarray(dfa_match(jnp.asarray(compiled.table),
+                               jnp.asarray(compiled.accept),
+                               jnp.asarray(compiled.starts),
+                               jnp.asarray(data)))
+    # chunked: 4 chunks of 8 bytes
+    states = jnp.broadcast_to(
+        jnp.asarray(compiled.starts)[None, :],
+        (len(texts), compiled.starts.shape[0])).astype(jnp.int32)
+    for c in range(0, L, 8):
+        states = dfa_scan(jnp.asarray(compiled.table), states,
+                          jnp.asarray(data[:, c:c + 8]))
+    chunked = np.asarray(jnp.asarray(compiled.accept)[states])
+    np.testing.assert_array_equal(one, chunked)
+
+
+def test_unsupported_constructs_rejected():
+    with pytest.raises(RegexCompileError):
+        compile_regex_set([r"(?=look)ahead"])
+    with pytest.raises(RegexCompileError):
+        compile_regex_set([r"(a)\1"])
+
+
+def test_state_budget_enforced():
+    with pytest.raises(RegexCompileError):
+        compile_regex_set(["(a|b){40}" * 8], max_states=64)
+
+
+def test_overlong_input_never_matches():
+    compiled = compile_regex_set([".*"])
+    data = jnp.asarray(encode_strings(["x" * 100], 8))
+    got = np.asarray(dfa_match(jnp.asarray(compiled.table),
+                               jnp.asarray(compiled.accept),
+                               jnp.asarray(compiled.starts), data))
+    assert not got.any()
