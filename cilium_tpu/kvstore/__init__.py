@@ -1,0 +1,26 @@
+"""Distributed control-plane key-value store.
+
+TPU-native recast of the reference's ``pkg/kvstore``: a backend interface
+(reference: pkg/kvstore/backend.go:86-146) carrying the three replicated
+stores (identities, ip->identity, nodes), with an in-process backend for
+tests/single-node operation (reference: pkg/kvstore/dummy.go) and the
+distributed ID-allocation protocol (reference: pkg/kvstore/allocator/).
+
+An etcd backend slot exists behind the same interface; in this image no
+etcd client library is available so distribution across real hosts rides
+the in-process backend shared between components (a remote backend is a
+drop-in via ``register_backend``).
+"""
+
+from .backend import (EVENT_CREATE, EVENT_DELETE, EVENT_LIST_DONE,
+                      EVENT_MODIFY, BackendOperations, Event, KVLockError,
+                      close_client, get_client, register_backend,
+                      setup_client, setup_dummy)
+from .memory import InMemoryBackend
+
+__all__ = [
+    "BackendOperations", "Event", "InMemoryBackend", "KVLockError",
+    "EVENT_CREATE", "EVENT_MODIFY", "EVENT_DELETE", "EVENT_LIST_DONE",
+    "setup_client", "setup_dummy", "get_client", "close_client",
+    "register_backend",
+]

@@ -1,0 +1,270 @@
+"""kvstore backend, shared store, and distributed allocator tests.
+
+Models the reference's allocator/kvstore test approach: everything runs
+against the in-process backend (pkg/kvstore/dummy.go analog), including
+multi-node scenarios via several clients sharing one MemStore.
+"""
+
+import threading
+
+import pytest
+
+from cilium_tpu.identity import MINIMAL_NUMERIC_IDENTITY, RESERVED_WORLD
+from cilium_tpu.kvstore import (EVENT_CREATE, EVENT_DELETE, EVENT_LIST_DONE,
+                                EVENT_MODIFY, InMemoryBackend, KVLockError)
+from cilium_tpu.kvstore.allocator import Allocator
+from cilium_tpu.kvstore.identity_allocator import (
+    DistributedIdentityAllocator, decode_labels, encode_labels)
+from cilium_tpu.kvstore.memory import MemStore
+from cilium_tpu.kvstore.store import SharedStore
+from cilium_tpu.labels import Labels, parse_label
+
+
+def two_clients():
+    store = MemStore()
+    return InMemoryBackend(store), InMemoryBackend(store)
+
+
+class TestBackend:
+    def test_set_get_delete(self):
+        b = InMemoryBackend()
+        assert b.get("a") is None
+        b.set("a", b"1")
+        assert b.get("a") == b"1"
+        b.delete("a")
+        assert b.get("a") is None
+
+    def test_create_only_is_atomic_between_clients(self):
+        a, b = two_clients()
+        assert a.create_only("k", b"a")
+        assert not b.create_only("k", b"b")
+        assert b.get("k") == b"a"
+
+    def test_create_if_exists(self):
+        b = InMemoryBackend()
+        assert not b.create_if_exists("master", "slave", b"v")
+        b.set("master", b"m")
+        assert b.create_if_exists("master", "slave", b"v")
+        assert b.get("slave") == b"v"
+        # second create of an existing slave fails
+        assert not b.create_if_exists("master", "slave", b"v2")
+
+    def test_list_prefix(self):
+        b = InMemoryBackend()
+        b.set("p/x", b"1")
+        b.set("p/y", b"2")
+        b.set("q/z", b"3")
+        assert b.list_prefix("p/") == {"p/x": b"1", "p/y": b"2"}
+        b.delete_prefix("p/")
+        assert b.list_prefix("p/") == {}
+
+    def test_watch_sees_changes(self):
+        a, b = two_clients()
+        w = a.watch("pfx/")
+        b.set("pfx/k", b"v")
+        b.set("pfx/k", b"v2")
+        b.delete("pfx/k")
+        b.set("other/k", b"x")  # not under the prefix
+        evs = [w.next_event(timeout=1.0) for _ in range(3)]
+        assert [(e.typ, e.key) for e in evs] == [
+            (EVENT_CREATE, "pfx/k"), (EVENT_MODIFY, "pfx/k"),
+            (EVENT_DELETE, "pfx/k")]
+        assert w.next_event(timeout=0.05) is None
+        w.stop()
+
+    def test_list_and_watch_replays_then_streams(self):
+        a, b = two_clients()
+        b.set("s/1", b"one")
+        w = a.list_and_watch("s/")
+        first = w.next_event(timeout=1.0)
+        assert (first.typ, first.key, first.value) == \
+            (EVENT_CREATE, "s/1", b"one")
+        assert w.next_event(timeout=1.0).typ == EVENT_LIST_DONE
+        b.set("s/2", b"two")
+        assert w.next_event(timeout=1.0).key == "s/2"
+        w.stop()
+
+    def test_lease_keys_vanish_when_session_dies(self):
+        a, b = two_clients()
+        w = b.watch("lease/")
+        a.set("lease/mine", b"v", lease=True)
+        a.set("lease/plain", b"v")
+        assert w.next_event(timeout=1.0).typ == EVENT_CREATE
+        assert w.next_event(timeout=1.0).typ == EVENT_CREATE
+        a.expire_now()  # node failure
+        ev = w.next_event(timeout=1.0)
+        assert (ev.typ, ev.key) == (EVENT_DELETE, "lease/mine")
+        assert b.get("lease/plain") == b"v"
+        w.stop()
+
+    def test_lock_mutual_exclusion_and_timeout(self):
+        a, b = two_clients()
+        lock = a.lock_path("locks/x", timeout=1.0)
+        with pytest.raises(KVLockError):
+            b.lock_path("locks/x", timeout=0.1)
+        lock.unlock()
+        with b.lock_path("locks/x", timeout=1.0):
+            pass
+
+    def test_lock_released_on_session_death(self):
+        a, b = two_clients()
+        a.lock_path("locks/y", timeout=1.0)
+        a.expire_now()
+        with b.lock_path("locks/y", timeout=1.0):
+            pass
+
+
+class TestSharedStore:
+    def test_two_nodes_converge(self):
+        a, b = two_clients()
+        seen = {}
+        sa = SharedStore(a, "cilium/state/nodes/v1")
+        sb = SharedStore(b, "cilium/state/nodes/v1",
+                         on_update=lambda n, v: seen.__setitem__(n, v))
+        assert sa.wait_synced() and sb.wait_synced()
+        sa.update_local("node1", {"ip": "10.0.0.1"})
+        deadline = threading.Event()
+        for _ in range(100):
+            if sb.snapshot().get("node1") == {"ip": "10.0.0.1"}:
+                break
+            deadline.wait(0.01)
+        assert sb.snapshot()["node1"] == {"ip": "10.0.0.1"}
+        assert seen["node1"] == {"ip": "10.0.0.1"}
+        sa.delete_local("node1")
+        for _ in range(100):
+            if "node1" not in sb.snapshot():
+                break
+            deadline.wait(0.01)
+        assert "node1" not in sb.snapshot()
+        sa.close()
+        sb.close()
+
+
+class TestAllocator:
+    def test_same_key_same_id_across_nodes(self):
+        a, b = two_clients()
+        alloc_a = Allocator(a, "cilium/state/identities/v1", "node-a",
+                            256, 65535, seed=1)
+        alloc_b = Allocator(b, "cilium/state/identities/v1", "node-b",
+                            256, 65535, seed=2)
+        id_a, new_a = alloc_a.allocate("app=foo")
+        id_b, new_b = alloc_b.allocate("app=foo")
+        assert id_a == id_b
+        assert new_a and not new_b
+        assert 256 <= id_a <= 65535
+
+    def test_different_keys_different_ids(self):
+        alloc = Allocator(InMemoryBackend(), "pfx", "n", 256, 65535, seed=3)
+        ids = {alloc.allocate(f"key-{i}")[0] for i in range(50)}
+        assert len(ids) == 50
+
+    def test_refcount_release_and_gc(self):
+        a, b = two_clients()
+        alloc_a = Allocator(a, "pfx", "node-a", 256, 65535, seed=4)
+        alloc_b = Allocator(b, "pfx", "node-b", 256, 65535, seed=5)
+        id_, _ = alloc_a.allocate("k")
+        alloc_b.allocate("k")
+        alloc_a.allocate("k")  # refcount 2 on node-a
+        # master survives while any slave key exists
+        assert not alloc_a.release("k")
+        assert alloc_a.release("k")
+        assert alloc_a.run_gc() == 0  # node-b still holds it
+        assert alloc_b.release("k")
+        assert alloc_b.run_gc() == 1  # masterless now; reclaimed
+        assert a.get(f"pfx/id/{id_}") is None
+
+    def test_lease_expiry_frees_ids_for_gc(self):
+        a, b = two_clients()
+        alloc_a = Allocator(a, "pfx", "node-a", 256, 65535, seed=6)
+        alloc_b = Allocator(b, "pfx", "node-b", 256, 65535, seed=7)
+        alloc_a.allocate("k")
+        a.expire_now()  # node-a dies; its slave key lease reaps
+        assert alloc_b.run_gc() == 1
+
+    def test_watch_cache_feeds_other_nodes(self):
+        a, b = two_clients()
+        alloc_a = Allocator(a, "pfx", "node-a", 256, 65535, seed=8)
+        alloc_b = Allocator(b, "pfx", "node-b", 256, 65535, seed=9)
+        id_, _ = alloc_a.allocate("shared")
+        for _ in range(100):
+            if alloc_b.get("shared") == id_:
+                break
+            threading.Event().wait(0.01)
+        assert alloc_b.get("shared") == id_
+        assert alloc_b.get_by_id(id_) == "shared"
+
+    def test_concurrent_allocation_converges(self):
+        store = MemStore()
+        results = {}
+
+        def worker(name):
+            alloc = Allocator(InMemoryBackend(store), "pfx", name,
+                              256, 65535)
+            results[name] = alloc.allocate("contended")[0]
+
+        threads = [threading.Thread(target=worker, args=(f"n{i}",))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(results.values())) == 1
+
+
+class TestDistributedIdentityAllocator:
+    def labels(self, *strs):
+        return Labels.from_labels(parse_label(s) for s in strs)
+
+    def test_label_key_roundtrip(self):
+        lbls = self.labels("k8s:app=web", "k8s:io.kubernetes.pod.namespace=x",
+                           "cidr:10.0.0.0/8")
+        assert decode_labels(encode_labels(lbls)).sorted_list() == \
+            lbls.sorted_list()
+
+    def test_same_labels_same_identity_across_nodes(self):
+        a, b = two_clients()
+        da = DistributedIdentityAllocator(a, "node-a", seed=1)
+        db = DistributedIdentityAllocator(b, "node-b", seed=2)
+        lbls = self.labels("k8s:app=web")
+        ia, new_a = da.allocate(lbls)
+        ib, new_b = db.allocate(lbls)
+        assert ia.id == ib.id >= MINIMAL_NUMERIC_IDENTITY
+        assert new_a and not new_b
+        assert db.lookup_by_id(ia.id).labels.sorted_list() == \
+            lbls.sorted_list()
+
+    def test_reserved_short_circuit(self):
+        da = DistributedIdentityAllocator(InMemoryBackend(), "n")
+        ident, is_new = da.allocate(self.labels("reserved:world"))
+        assert ident.id == RESERVED_WORLD and not is_new
+
+    def test_cluster_id_bits(self):
+        da = DistributedIdentityAllocator(InMemoryBackend(), "n",
+                                          cluster_id=3, seed=3)
+        ident, _ = da.allocate(self.labels("k8s:app=x"))
+        assert ident.id >> 16 == 3
+        assert da.lookup_by_id(ident.id) is not None
+
+    def test_change_events(self):
+        a, b = two_clients()
+        events = []
+        DistributedIdentityAllocator(
+            b, "node-b", on_change=lambda t, i: events.append((t, i.id)))
+        da = DistributedIdentityAllocator(a, "node-a", seed=4)
+        ident, _ = da.allocate(self.labels("k8s:app=ev"))
+        da.release(ident)
+        da.run_gc()
+        for _ in range(100):
+            if ("delete", ident.id) in events:
+                break
+            threading.Event().wait(0.01)
+        assert ("add", ident.id) in events
+        assert ("delete", ident.id) in events
+
+    def test_snapshot_feeds_identity_cache(self):
+        from cilium_tpu.identity import IdentityCache
+        da = DistributedIdentityAllocator(InMemoryBackend(), "n", seed=5)
+        ident, _ = da.allocate(self.labels("k8s:app=cache"))
+        cache = IdentityCache.snapshot(da)
+        assert ident.id in cache
+        assert RESERVED_WORLD in cache
