@@ -1,0 +1,17 @@
+"""Endpoint lifecycle: state machine, policy regeneration, device sync.
+
+Analog of the reference's ``pkg/endpoint`` + ``pkg/endpointmanager`` +
+``pkg/buildqueue``: endpoints move through a validated state machine,
+resolve labels to identities, recompute desired policy-map state, and
+sync it into the stacked device verdict tables with minimal deltas.
+"""
+
+from .endpoint import (Endpoint, EndpointState, RegenerationResult,
+                       StateTransitionError)
+from .manager import EndpointManager
+from .tables import DeviceTableManager
+
+__all__ = [
+    "Endpoint", "EndpointState", "RegenerationResult",
+    "StateTransitionError", "EndpointManager", "DeviceTableManager",
+]

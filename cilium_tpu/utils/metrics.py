@@ -1,0 +1,228 @@
+"""Prometheus-style metrics registry (no external deps).
+
+Reference: pkg/metrics/metrics.go — a process-global registry with the
+policy-centric series (PolicyCount :180, PolicyRegenerationCount/Time
+:186-199, PolicyRevision :210, EndpointCount* :124-178, proxy series
+:263-276, datapath drop/forward counters fed from metricsmap) exposed in
+Prometheus text format at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _lk(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _escape(value: str) -> str:
+    """Escape a label value per the Prometheus exposition format."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_labels(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+
+    def expose(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text)
+        self._values: Dict[_LabelKey, float] = {}
+
+    def inc(self, amount: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        key = _lk(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(amount)
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(_lk(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            return [f"{self.name}{_fmt_labels(k)} {v}"
+                    for k, v in sorted(self._values.items())] or \
+                [f"{self.name} 0"]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text)
+        self._values: Dict[_LabelKey, float] = {}
+
+    def set(self, value: float,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_lk(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        key = _lk(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(amount)
+
+    def dec(self, amount: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        self.inc(-amount, labels)
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(_lk(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            return [f"{self.name}{_fmt_labels(k)} {v}"
+                    for k, v in sorted(self._values.items())] or \
+                [f"{self.name} 0"]
+
+
+DEFAULT_BUCKETS = (.0001, .0005, .001, .005, .01, .05, .1, .5, 1, 5, 10)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_text)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[_LabelKey, List[int]] = {}
+        self._sums: Dict[_LabelKey, float] = {}
+        self._totals: Dict[_LabelKey, int] = {}
+
+    def observe(self, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        key = _lk(labels)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def expose(self) -> List[str]:
+        out = []
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                for ub, c in zip(self.buckets, counts):
+                    lk = key + (("le", repr(ub)),)
+                    out.append(f"{self.name}_bucket{_fmt_labels(lk)} {c}")
+                inf = key + (("le", "+Inf"),)
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(inf)} "
+                    f"{self._totals[key]}")
+                out.append(f"{self.name}_sum{_fmt_labels(key)} "
+                           f"{self._sums[key]}")
+                out.append(f"{self.name}_count{_fmt_labels(key)} "
+                           f"{self._totals[key]}")
+        return out
+
+
+class Registry:
+    """Metric registry with Prometheus text exposition."""
+
+    def __init__(self, namespace: str = "cilium_tpu"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric):
+                    raise ValueError(
+                        f"metric {metric.name!r} already registered as "
+                        f"{type(existing).__name__}, not "
+                        f"{type(metric).__name__}")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._register(
+            Counter(f"{self.namespace}_{name}", help_text))
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._register(Gauge(f"{self.namespace}_{name}", help_text))
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._register(
+            Histogram(f"{self.namespace}_{name}", help_text, buckets))
+
+    def expose_text(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in sorted(metrics, key=lambda m: m.name):
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# Process-global registry + the reference's core series
+# (pkg/metrics/metrics.go:124-276).
+registry = Registry()
+
+ENDPOINT_COUNT = registry.gauge(
+    "endpoint_count", "Number of endpoints managed by this agent")
+ENDPOINT_REGENERATION_COUNT = registry.counter(
+    "endpoint_regenerations",
+    "Count of all endpoint regenerations that have completed")
+ENDPOINT_REGENERATION_TIME = registry.histogram(
+    "endpoint_regeneration_seconds",
+    "Endpoint regeneration time")
+ENDPOINT_STATE_COUNT = registry.gauge(
+    "endpoint_state", "Count of all endpoints by state")
+POLICY_COUNT = registry.gauge(
+    "policy_count", "Number of policy rules loaded")
+POLICY_REVISION = registry.gauge(
+    "policy_max_revision", "Highest policy revision number in the agent")
+POLICY_REGENERATION_COUNT = registry.counter(
+    "policy_regeneration_total", "Count of policy regenerations")
+POLICY_IMPORT_ERRORS = registry.counter(
+    "policy_import_errors", "Count of failed policy imports")
+POLICY_VERDICTS = registry.counter(
+    "policy_verdicts_total", "Datapath verdicts by outcome")
+PROXY_REDIRECTS = registry.gauge(
+    "proxy_redirects", "Number of active proxy redirects")
+PROXY_UPSTREAM_TIME = registry.histogram(
+    "proxy_upstream_reply_seconds", "Proxy upstream reply time")
+DROP_COUNT = registry.counter(
+    "drop_count_total", "Dropped packets by reason")
+FORWARD_COUNT = registry.counter(
+    "forward_count_total", "Forwarded packets")
+IDENTITY_COUNT = registry.gauge(
+    "identity_count", "Number of security identities allocated")
+KVSTORE_OPERATIONS = registry.counter(
+    "kvstore_operations_total", "kvstore operations by kind")
