@@ -3,6 +3,13 @@
 Real TPU hardware has a single chip in this environment; multi-chip code
 paths are validated on a virtual CPU mesh exactly like the driver's
 dryrun_multichip harness.
+
+The axon TPU plugin's sitecustomize force-sets
+``jax.config jax_platforms="axon,cpu"`` at interpreter start (overriding
+the JAX_PLATFORMS env var), so merely setting the env here is not
+enough: we re-override the config after importing jax, before any
+backend is initialized. Otherwise every test run hangs dialing the TPU
+relay even though tests only need CPU.
 """
 
 import os
@@ -13,5 +20,9 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env setup on purpose)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
