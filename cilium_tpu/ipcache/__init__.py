@@ -1,0 +1,22 @@
+"""Global IP/CIDR -> security-identity cache (control side).
+
+Analog of the reference's ``pkg/ipcache``: a source-precedence-aware
+table of IP-to-identity mappings, distributed through the kvstore at
+``cilium/state/ip/v1/``, with listeners that push changes into the
+datapath LPM tables and CIDR-identity allocation for policy prefixes.
+"""
+
+from .cidr import allocate_cidr_identities, release_cidr_identities
+from .ipcache import (SOURCE_AGENT_LOCAL, SOURCE_CUSTOM_RESOURCE,
+                      SOURCE_GENERATED, SOURCE_K8S, SOURCE_KVSTORE,
+                      SOURCE_LOCAL, IPCache, IPIdentityPair)
+from .kvstore_sync import IPIdentityWatcher, KVStoreIPCacheSyncer
+from .listener import DatapathLPMListener
+
+__all__ = [
+    "IPCache", "IPIdentityPair", "SOURCE_AGENT_LOCAL", "SOURCE_LOCAL",
+    "SOURCE_KVSTORE", "SOURCE_K8S", "SOURCE_CUSTOM_RESOURCE",
+    "SOURCE_GENERATED", "IPIdentityWatcher", "KVStoreIPCacheSyncer",
+    "DatapathLPMListener", "allocate_cidr_identities",
+    "release_cidr_identities",
+]

@@ -1,0 +1,253 @@
+"""ipcache control plane, node registry, and clustermesh tests.
+
+Mirrors the reference's pkg/ipcache tests (source precedence,
+listener), pkg/node store/manager behavior, and clustermesh
+multi-cluster sync with cluster-scoped identities.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.clustermesh import ClusterMesh, scope_identity
+from cilium_tpu.compiler.lpm import oracle_lpm
+from cilium_tpu.identity import RESERVED_WORLD, LocalIdentityAllocator
+from cilium_tpu.ipcache import (SOURCE_AGENT_LOCAL, SOURCE_GENERATED,
+                                SOURCE_K8S, SOURCE_KVSTORE,
+                                DatapathLPMListener, IPCache,
+                                IPIdentityWatcher, KVStoreIPCacheSyncer,
+                                allocate_cidr_identities,
+                                release_cidr_identities)
+from cilium_tpu.kvstore.memory import InMemoryBackend, MemStore
+from cilium_tpu.node import Node, NodeAddress, NodeManager, NodeRegistry
+
+
+def wait_until(fn, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+# ----------------------------------------------------------------- ipcache
+
+def test_ipcache_source_precedence():
+    c = IPCache()
+    assert c.upsert("10.0.0.1", 300, SOURCE_KVSTORE)
+    # lower-precedence k8s may not overwrite kvstore
+    assert not c.upsert("10.0.0.1", 400, SOURCE_K8S)
+    assert c.lookup_by_ip("10.0.0.1") == 300
+    # higher-precedence agent-local wins
+    assert c.upsert("10.0.0.1", 500, SOURCE_AGENT_LOCAL)
+    assert c.lookup_by_ip("10.0.0.1") == 500
+    # k8s cannot delete the agent-local entry either
+    assert not c.delete("10.0.0.1", SOURCE_K8S)
+    assert c.delete("10.0.0.1", SOURCE_AGENT_LOCAL)
+    assert c.lookup_by_ip("10.0.0.1") is None
+    with pytest.raises(ValueError):
+        c.upsert("10.0.0.1", 1, "bogus-source")
+
+
+def test_ipcache_listeners_and_reverse_index():
+    c = IPCache()
+    events = []
+    c.upsert("10.1.0.0/16", 201, SOURCE_KVSTORE)
+    # replay on registration delivers the existing entry
+    c.add_listener(lambda mod, pair, old: events.append((mod, pair.prefix,
+                                                         pair.identity)))
+    assert events == [("upsert", "10.1.0.0/16", 201)]
+    c.upsert("10.2.0.0/16", 202, SOURCE_KVSTORE)
+    c.upsert("10.2.0.0/16", 203, SOURCE_KVSTORE)  # modify
+    c.delete("10.1.0.0/16", SOURCE_KVSTORE)
+    assert ("upsert", "10.2.0.0/16", 203) in events
+    assert ("delete", "10.1.0.0/16", 201) in events
+    assert c.lookup_by_identity(203) == ["10.2.0.0/16"]
+    assert c.lookup_by_identity(202) == []
+
+
+def test_ipcache_longest_prefix_host_side():
+    c = IPCache()
+    c.upsert("10.0.0.0/8", 100, SOURCE_KVSTORE)
+    c.upsert("10.1.0.0/16", 200, SOURCE_KVSTORE)
+    c.upsert("10.1.2.3", 300, SOURCE_AGENT_LOCAL)
+    assert c.lookup_longest_prefix("10.1.2.3") == 300
+    assert c.lookup_longest_prefix("10.1.9.9") == 200
+    assert c.lookup_longest_prefix("10.9.9.9") == 100
+    assert c.lookup_longest_prefix("192.168.0.1") is None
+    # matches the compiled-LPM oracle on the same table
+    prefixes = c.to_lpm_prefixes()
+    for ip in ("10.1.2.3", "10.1.9.9", "10.9.9.9"):
+        assert oracle_lpm(prefixes, ip) == c.lookup_longest_prefix(ip)
+
+
+def test_ipcache_kvstore_distribution_two_agents():
+    """Agent A publishes; agent B's watcher ingests (ipcache/kvstore.go)."""
+    store = MemStore()
+    be_a = InMemoryBackend(store)
+    be_b = InMemoryBackend(store)
+
+    cache_a, cache_b = IPCache(), IPCache()
+    syncer = KVStoreIPCacheSyncer(be_a)
+    cache_a.add_listener(syncer.listener(), replay=False)
+
+    watcher = IPIdentityWatcher(be_b, cache_b)
+    watcher.start()
+    assert watcher.wait_synced(5)
+
+    cache_a.upsert("10.0.1.5", 777, SOURCE_AGENT_LOCAL,
+                   host_ip="192.168.1.10")
+    assert wait_until(lambda: cache_b.lookup_by_ip("10.0.1.5") == 777)
+    # the kvstore-sourced copy carries the host IP for encap
+    pair = [p for p in cache_b.dump() if p.identity == 777][0]
+    assert pair.host_ip == "192.168.1.10"
+    assert pair.source == SOURCE_KVSTORE
+
+    cache_a.delete("10.0.1.5", SOURCE_AGENT_LOCAL)
+    assert wait_until(lambda: cache_b.lookup_by_ip("10.0.1.5") is None)
+    watcher.stop()
+
+
+def test_datapath_lpm_listener_recompiles():
+    c = IPCache()
+    compiled_holder = []
+    listener = DatapathLPMListener(c, compiled_holder.append,
+                                   min_interval=0.0)
+    c.upsert("10.0.0.0/8", 100, SOURCE_KVSTORE)
+    c.upsert("10.1.0.0/16", 200, SOURCE_KVSTORE)
+    assert listener.flush(5)
+    compiled = compiled_holder[-1]
+    assert compiled.entry_count() == 2
+    assert oracle_lpm(c.to_lpm_prefixes(), "10.1.2.3") == 200
+    listener.shutdown()
+
+
+def test_cidr_identity_allocation_roundtrip():
+    alloc = LocalIdentityAllocator()
+    cache = IPCache()
+    idents = allocate_cidr_identities(alloc, cache,
+                                      ["10.0.0.0/8", "192.168.1.0/24"])
+    assert len(idents) == 2
+    id1 = cache.lookup_by_ip("10.0.0.0/8")
+    assert id1 == idents["10.0.0.0/8"].id >= 256
+    # same prefix twice -> same identity (refcounted)
+    again = allocate_cidr_identities(alloc, cache, ["10.0.0.0/8"])
+    assert again["10.0.0.0/8"].id == id1
+    # one release keeps it; the second frees and clears the cache
+    assert release_cidr_identities(alloc, cache, again) == 0
+    assert cache.lookup_by_ip("10.0.0.0/8") == id1
+    assert release_cidr_identities(
+        alloc, cache, {"10.0.0.0/8": idents["10.0.0.0/8"]}) == 1
+    assert cache.lookup_by_ip("10.0.0.0/8") is None
+
+
+# -------------------------------------------------------------------- nodes
+
+def _node(name, ip, pod_cidr, cluster="default", cluster_id=0):
+    return Node(name=name, cluster=cluster, cluster_id=cluster_id,
+                addresses=[NodeAddress(type="InternalIP", ip=ip)],
+                ipv4_alloc_cidr=pod_cidr)
+
+
+def test_node_registry_two_agents_discover_each_other():
+    store = MemStore()
+    reg_a = NodeRegistry(InMemoryBackend(store))
+    reg_b = NodeRegistry(InMemoryBackend(store))
+    assert reg_a.wait_synced(5) and reg_b.wait_synced(5)
+
+    reg_a.register_local(_node("node-a", "192.168.0.1", "10.1.0.0/16"))
+    reg_b.register_local(_node("node-b", "192.168.0.2", "10.2.0.0/16"))
+    assert wait_until(lambda: len(reg_a) == 2 and len(reg_b) == 2)
+    names = [n.name for n in reg_a.nodes()]
+    assert names == ["node-a", "node-b"]
+    got = reg_a.get("default/node-b")
+    assert got.get_node_ip() == "192.168.0.2"
+
+    reg_b.unregister_local(_node("node-b", "192.168.0.2", "10.2.0.0/16"))
+    assert wait_until(lambda: len(reg_a) == 1)
+    reg_a.close()
+    reg_b.close()
+
+
+def test_node_manager_programs_tunnel_and_ipcache():
+    cache = IPCache()
+    mgr = NodeManager("default/node-a", ipcache=cache)
+    peer = _node("node-b", "192.168.0.2", "10.2.0.0/16")
+    mgr.node_updated(peer)
+    assert mgr.tunnel_endpoint_for("10.2.0.0/16") == "192.168.0.2"
+    assert cache.lookup_by_ip("10.2.0.0/16") == RESERVED_WORLD
+    # pod-CIDR move reprograms
+    moved = _node("node-b", "192.168.0.2", "10.3.0.0/16")
+    mgr.node_updated(moved)
+    assert mgr.tunnel_endpoint_for("10.2.0.0/16") is None
+    assert mgr.tunnel_endpoint_for("10.3.0.0/16") == "192.168.0.2"
+    # the local node programs nothing
+    mgr.node_updated(_node("node-a", "192.168.0.1", "10.1.0.0/16"))
+    assert mgr.tunnel_endpoint_for("10.1.0.0/16") is None
+    mgr.node_deleted("default/node-b")
+    assert mgr.tunnel_endpoint_for("10.3.0.0/16") is None
+    assert cache.lookup_by_ip("10.3.0.0/16") is None
+
+
+# -------------------------------------------------------------- clustermesh
+
+def test_scope_identity_bits():
+    assert scope_identity(3, 1000) == (3 << 16) | 1000
+    assert scope_identity(0, 1000) == 1000
+    # reserved identities stay unscoped
+    assert scope_identity(3, RESERVED_WORLD) == RESERVED_WORLD
+
+
+def test_clustermesh_syncs_remote_nodes_and_ips():
+    remote_store = MemStore()
+    # the "remote cluster" publishes a node + an ip mapping
+    remote_reg = NodeRegistry(InMemoryBackend(remote_store))
+    remote_reg.register_local(
+        _node("r-node-1", "172.16.0.1", "10.9.0.0/16", cluster="east"))
+    remote_cache = IPCache()
+    syncer = KVStoreIPCacheSyncer(InMemoryBackend(remote_store))
+    remote_cache.add_listener(syncer.listener(), replay=False)
+    remote_cache.upsert("10.9.1.4", 2000, SOURCE_AGENT_LOCAL)
+
+    local_cache = IPCache()
+    seen_nodes = []
+    mesh = ClusterMesh(ipcache=local_cache,
+                       on_node_update=lambda n: seen_nodes.append(n))
+    rc = mesh.add_cluster("east", 3,
+                          lambda: InMemoryBackend(remote_store))
+    assert rc.connected.wait(5)
+    assert wait_until(lambda: len(seen_nodes) >= 1)
+    assert seen_nodes[0].name == "r-node-1"
+    assert seen_nodes[0].cluster_id == 3
+    # remote identity arrives scoped with cluster bits
+    assert wait_until(
+        lambda: local_cache.lookup_by_ip("10.9.1.4") ==
+        scope_identity(3, 2000))
+    assert mesh.num_ready() == 1
+    st = mesh.status()[0]
+    assert st["name"] == "east" and st["ready"]
+
+    mesh.remove_cluster("east")
+    assert mesh.num_ready() == 0
+    remote_reg.close()
+
+
+def test_clustermesh_reconnects_after_failure():
+    attempts = []
+    store = MemStore()
+
+    def flaky_factory():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ConnectionError("remote etcd down")
+        return InMemoryBackend(store)
+
+    mesh = ClusterMesh()
+    rc = mesh.add_cluster("west", 2, flaky_factory)
+    assert rc.connected.wait(10)
+    assert len(attempts) == 3
+    assert rc.failures == 2
+    mesh.close()
