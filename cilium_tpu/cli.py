@@ -1,0 +1,390 @@
+"""The ``cilium-tpu`` CLI.
+
+Mirrors the reference's ``cilium`` command families (cilium/cmd/, 75
+commands) against the REST API: policy {get,import,delete,trace},
+endpoint {list,get,config,labels,delete}, identity {list,get},
+service {list,update,delete}, prefilter {list,update,delete},
+monitor, status, config, metrics, and the map-dump debugging surface
+(``bpf policy list`` analog comes from /endpoint + /monitor/stats).
+
+Run the agent itself with ``cilium-tpu agent``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+DEFAULT_API = "http://127.0.0.1:9234"
+
+
+class Client:
+    """Tiny REST client (pkg/client analog)."""
+
+    def __init__(self, base_url: str = DEFAULT_API):
+        self.base_url = base_url.rstrip("/")
+
+    def request(self, method: str, path: str, body=None,
+                raw: bool = False, raw_body: Optional[bytes] = None):
+        data = raw_body if raw_body is not None else \
+            (None if body is None else json.dumps(body).encode())
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                msg = json.loads(payload).get("error", payload.decode())
+            except ValueError:
+                msg = payload.decode(errors="replace")
+            raise SystemExit(f"API error {e.code}: {msg}")
+        except urllib.error.URLError as e:
+            raise SystemExit(
+                f"cannot reach agent at {self.base_url}: {e.reason}")
+        if raw:
+            return payload.decode()
+        return json.loads(payload) if payload else None
+
+    def get(self, path, **kw):
+        return self.request("GET", path, **kw)
+
+    def put(self, path, body=None):
+        return self.request("PUT", path, body)
+
+    def post(self, path, body=None):
+        return self.request("POST", path, body)
+
+    def patch(self, path, body=None):
+        return self.request("PATCH", path, body)
+
+    def delete(self, path, body=None):
+        return self.request("DELETE", path, body)
+
+
+def _print_json(obj) -> None:
+    print(json.dumps(obj, indent=2, sort_keys=True))
+
+
+# ------------------------------------------------------------- subcommands
+
+def cmd_status(c: Client, args) -> int:
+    st = c.get("/healthz")
+    if args.json:
+        _print_json(st)
+        return 0
+    kv = st["kvstore"]
+    print(f"KVStore:       {kv['state']} ({kv['backend']})")
+    print(f"Policy:        revision {st['policy']['revision']}, "
+          f"{st['policy']['rules']} rules")
+    eps = st["endpoints"]
+    states = " ".join(f"{k}={v}" for k, v in
+                      sorted(eps.get("by-state", {}).items()))
+    print(f"Endpoints:     {eps['total']} ({states})")
+    print(f"Identities:    {st['identities']}")
+    print(f"IPCache:       {st['ipcache']} entries")
+    print(f"Nodes:         {st['nodes']} peers")
+    print(f"Proxy:         {st['proxy']['redirects']} redirects")
+    for cm in st.get("clustermesh", []):
+        ready = "ready" if cm["ready"] else "connecting"
+        print(f"ClusterMesh:   {cm['name']} (id {cm['cluster-id']}): "
+              f"{ready}, {cm['num-nodes']} nodes")
+    bad = [ctl for ctl in st.get("controllers", [])
+           if ctl["consecutive-failure-count"] > 0]
+    print(f"Controllers:   {len(st.get('controllers', []))} "
+          f"({len(bad)} failing)")
+    return 0
+
+
+def cmd_policy(c: Client, args) -> int:
+    if args.policy_cmd == "get":
+        _print_json(c.get("/policy"))
+    elif args.policy_cmd == "import":
+        text = sys.stdin.read() if args.file == "-" else \
+            open(args.file).read()
+        # validate client-side first for a friendly error
+        from .policy.jsonio import rules_from_json
+        rules_from_json(text)
+        out = c.request("PUT", "/policy", raw_body=text.encode())
+        print(f"Revision: {out['revision']}")
+    elif args.policy_cmd == "delete":
+        path = "/policy"
+        if args.labels:
+            from urllib.parse import urlencode
+            path += "?" + urlencode([("labels", l) for l in args.labels])
+        out = c.delete(path)
+        print(f"Revision: {out['revision']} ({out['deleted']} deleted)")
+    elif args.policy_cmd == "trace":
+        out = c.post("/policy/resolve", {
+            "from": args.src, "to": args.dst,
+            "dports": [int(p) for p in args.dport or []],
+            "verbose": args.verbose})
+        print(out["trace"])
+        print(f"Final verdict: {out['verdict'].upper()}")
+        return 0 if out["verdict"] == "allowed" else 1
+    return 0
+
+
+def cmd_endpoint(c: Client, args) -> int:
+    if args.endpoint_cmd == "list":
+        eps = c.get("/endpoint")
+        fmt = "{:<8} {:<12} {:<16} {:<10} {:<24} {}"
+        print(fmt.format("ID", "STATE", "IPv4", "IDENTITY",
+                         "CONTAINER", "LABELS"))
+        for ep in eps:
+            print(fmt.format(
+                ep["id"], ep["state"], ep["addressing"]["ipv4"] or "-",
+                ep["identity"]["id"], ep["container-name"] or "-",
+                ",".join(ep["labels"])))
+    elif args.endpoint_cmd == "get":
+        _print_json(c.get(f"/endpoint/{args.id}"))
+    elif args.endpoint_cmd == "delete":
+        c.delete(f"/endpoint/{args.id}")
+        print(f"Endpoint {args.id} deleted")
+    elif args.endpoint_cmd == "config":
+        changes = {}
+        for kv in args.options or []:
+            k, _, v = kv.partition("=")
+            changes[k] = v
+        if not changes:
+            ep = c.get(f"/endpoint/{args.id}")
+            _print_json(ep)
+        else:
+            out = c.patch(f"/endpoint/{args.id}/config", changes)
+            print(f"Changed {out['changed']} option(s)")
+    elif args.endpoint_cmd == "labels":
+        out = c.patch(f"/endpoint/{args.id}", {"labels": args.labels})
+        print("Labels updated" if out.get("ok") else "No change")
+    return 0
+
+
+def cmd_identity(c: Client, args) -> int:
+    if args.identity_cmd == "list":
+        idents = c.get("/identity")
+        print(f"{'ID':<12} LABELS")
+        for i in idents:
+            print(f"{i['id']:<12} {','.join(i['labels'])}")
+    elif args.identity_cmd == "get":
+        _print_json(c.get(f"/identity/{args.id}"))
+    return 0
+
+
+def cmd_service(c: Client, args) -> int:
+    if args.service_cmd == "list":
+        svcs = c.get("/service")
+        print(f"{'FRONTEND':<24} BACKENDS")
+        for s in svcs:
+            front = f"{s['vip']}:{s['port']}"
+            backs = ", ".join(f"{b['ip']}:{b['port']}"
+                              for b in s["backends"])
+            print(f"{front:<24} {backs}")
+    elif args.service_cmd == "update":
+        backends = []
+        for b in args.backends:
+            ip, _, port = b.rpartition(":")
+            backends.append({"ip": ip, "port": int(port)})
+        vip, _, port = args.frontend.rpartition(":")
+        c.put("/service", {"vip": vip, "port": int(port),
+                           "backends": backends})
+        print("Service updated")
+    elif args.service_cmd == "delete":
+        vip, _, port = args.frontend.rpartition(":")
+        c.delete("/service", {"vip": vip, "port": int(port)})
+        print("Service deleted")
+    return 0
+
+
+def cmd_prefilter(c: Client, args) -> int:
+    if args.prefilter_cmd == "list":
+        out = c.get("/prefilter")
+        print(f"Revision: {out['revision']}")
+        for cidr in out["cidrs"]:
+            print(cidr)
+    elif args.prefilter_cmd == "update":
+        out = c.patch("/prefilter", {"cidrs": args.cidrs})
+        print(f"Revision: {out['revision']}")
+    elif args.prefilter_cmd == "delete":
+        out = c.delete("/prefilter", {"cidrs": args.cidrs})
+        print(f"Revision: {out['revision']}")
+    return 0
+
+
+def cmd_monitor(c: Client, args) -> int:
+    if args.stats:
+        _print_json(c.get("/monitor/stats"))
+        return 0
+    # events in one batch share a timestamp, so dedupe on the full
+    # event tuple (bounded), not the timestamp alone
+    seen = set()
+    try:
+        while True:
+            events = c.get(
+                f"/monitor?n=200&drops={'true' if args.drops else 'false'}")
+            for e in events:
+                key = (e["timestamp"], e["code"], e["endpoint"],
+                       e["identity"], e["dport"], e["proto"], e["length"])
+                if key not in seen:
+                    seen.add(key)
+                    print(e["message"])
+            if len(seen) > 100_000:
+                seen = set(sorted(seen)[-50_000:])
+            if not args.follow:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_config(c: Client, args) -> int:
+    if not args.options:
+        _print_json(c.get("/config"))
+        return 0
+    changes = {}
+    for kv in args.options:
+        k, _, v = kv.partition("=")
+        changes[k] = v
+    out = c.patch("/config", changes)
+    print(f"Changed {out['changed']} option(s)")
+    return 0
+
+
+def cmd_metrics(c: Client, args) -> int:
+    print(c.get("/metrics", raw=True), end="")
+    return 0
+
+
+def cmd_agent(args) -> int:
+    """Run the agent + API server in the foreground."""
+    from .daemon import Daemon
+    from .daemon.rest import APIServer
+    from .kvstore.backend import setup_client
+    from .utils.option import DaemonConfig
+
+    cfg = DaemonConfig(cluster_name=args.cluster_name,
+                       cluster_id=args.cluster_id,
+                       state_dir=args.state_dir)
+    kv = None
+    if args.kvstore and args.kvstore != "none":
+        kv = setup_client(args.kvstore)
+    d = Daemon(config=cfg, kvstore_backend=kv, node_name=args.node_name)
+    restored = d.restore_endpoints()
+    server = APIServer(d, port=args.api_port).start()
+    print(f"cilium-tpu agent up: api={server.base_url} "
+          f"restored={restored} endpoints")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+        d.shutdown()
+    return 0
+
+
+# ------------------------------------------------------------------ parser
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cilium-tpu",
+        description="TPU-native policy enforcement framework CLI")
+    p.add_argument("--api", default=DEFAULT_API,
+                   help="agent API base URL")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("status", help="agent health and state")
+    sp.add_argument("--json", action="store_true")
+
+    pol = sub.add_parser("policy", help="policy management")
+    pol_sub = pol.add_subparsers(dest="policy_cmd", required=True)
+    pol_sub.add_parser("get")
+    imp = pol_sub.add_parser("import")
+    imp.add_argument("file", help="rules JSON file, or - for stdin")
+    dele = pol_sub.add_parser("delete")
+    dele.add_argument("--labels", nargs="*", default=[])
+    tr = pol_sub.add_parser("trace")
+    tr.add_argument("--src", nargs="+", required=True)
+    tr.add_argument("--dst", nargs="+", required=True)
+    tr.add_argument("--dport", nargs="*")
+    tr.add_argument("-v", "--verbose", action="store_true")
+
+    ep = sub.add_parser("endpoint", help="endpoint management")
+    ep_sub = ep.add_subparsers(dest="endpoint_cmd", required=True)
+    ep_sub.add_parser("list")
+    for name in ("get", "delete"):
+        e = ep_sub.add_parser(name)
+        e.add_argument("id", type=int)
+    e = ep_sub.add_parser("config")
+    e.add_argument("id", type=int)
+    e.add_argument("options", nargs="*", help="Option=value")
+    e = ep_sub.add_parser("labels")
+    e.add_argument("id", type=int)
+    e.add_argument("labels", nargs="+")
+
+    idp = sub.add_parser("identity", help="security identities")
+    id_sub = idp.add_subparsers(dest="identity_cmd", required=True)
+    id_sub.add_parser("list")
+    g = id_sub.add_parser("get")
+    g.add_argument("id", type=int)
+
+    svc = sub.add_parser("service", help="service load balancing")
+    svc_sub = svc.add_subparsers(dest="service_cmd", required=True)
+    svc_sub.add_parser("list")
+    up = svc_sub.add_parser("update")
+    up.add_argument("--frontend", required=True, help="VIP:port")
+    up.add_argument("--backends", nargs="+", required=True,
+                    help="ip:port ...")
+    de = svc_sub.add_parser("delete")
+    de.add_argument("--frontend", required=True)
+
+    pf = sub.add_parser("prefilter", help="XDP-prefilter analog CIDRs")
+    pf_sub = pf.add_subparsers(dest="prefilter_cmd", required=True)
+    pf_sub.add_parser("list")
+    for name in ("update", "delete"):
+        u = pf_sub.add_parser(name)
+        u.add_argument("cidrs", nargs="+")
+
+    mon = sub.add_parser("monitor", help="datapath event monitor")
+    mon.add_argument("--drops", action="store_true")
+    mon.add_argument("--stats", action="store_true")
+    mon.add_argument("-f", "--follow", action="store_true")
+    mon.add_argument("--interval", type=float, default=1.0)
+
+    cfgp = sub.add_parser("config", help="daemon options")
+    cfgp.add_argument("options", nargs="*", help="Option=value")
+
+    sub.add_parser("metrics", help="Prometheus metrics dump")
+
+    ag = sub.add_parser("agent", help="run the agent")
+    ag.add_argument("--api-port", type=int, default=9234)
+    ag.add_argument("--kvstore", default="none",
+                    help="none | in-memory | backend name")
+    ag.add_argument("--cluster-name", default="default")
+    ag.add_argument("--cluster-id", type=int, default=0)
+    ag.add_argument("--node-name", default="node-local")
+    ag.add_argument("--state-dir", default="")
+    return p
+
+
+COMMANDS = {
+    "status": cmd_status, "policy": cmd_policy, "endpoint": cmd_endpoint,
+    "identity": cmd_identity, "service": cmd_service,
+    "prefilter": cmd_prefilter, "monitor": cmd_monitor,
+    "config": cmd_config, "metrics": cmd_metrics,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "agent":
+        return cmd_agent(args)
+    return COMMANDS[args.cmd](Client(args.api), args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

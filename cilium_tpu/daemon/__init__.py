@@ -1,0 +1,11 @@
+"""The agent daemon: composition root wiring every subsystem.
+
+Analog of the reference's ``daemon/`` — policy repository, identity
+allocation, ipcache, endpoint lifecycle + regeneration into device
+tables, proxy redirects, service LB, prefilter, node discovery,
+clustermesh, monitor, metrics, REST API and CLI.
+"""
+
+from .daemon import Daemon
+
+__all__ = ["Daemon"]

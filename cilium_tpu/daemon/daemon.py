@@ -1,0 +1,581 @@
+"""The Daemon.
+
+Reference: daemon/daemon.go:1090 NewDaemon (bootstrap order), daemon/
+policy.go:171 PolicyAdd / :48 TriggerPolicyUpdates, daemon/endpoint.go
+(REST endpoint lifecycle), daemon/state.go (restore), daemon/status.go.
+
+TPU shape: the daemon owns one Datapath (device tables + CT state), one
+DeviceTableManager-backed regeneration pipeline, and replicates control
+state (identities, ipcache, nodes) through the kvstore exactly like the
+reference — the "communication backend" is the kvstore plus the device
+swap path, not NCCL.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import identity as idpkg
+from ..clustermesh import ClusterMesh
+from ..datapath.engine import Datapath
+from ..datapath.lb import Backend, Service
+from ..endpoint import Endpoint, EndpointManager, EndpointState
+from ..identity import (Identity, IdentityCache, LocalIdentityAllocator)
+from ..ipcache import (SOURCE_AGENT_LOCAL, IPCache, IPIdentityWatcher,
+                       KVStoreIPCacheSyncer, allocate_cidr_identities,
+                       release_cidr_identities)
+from ..kvstore import backend as kvbackend
+from ..kvstore.identity_allocator import DistributedIdentityAllocator
+from ..l7.dns import DNSCache, DNSPoller, inject_to_cidr_set
+from ..labels import Labels
+from ..monitor import MonitorHub
+from ..node import Node, NodeManager, NodeRegistry
+from ..policy.api import Rule
+from ..policy.repository import Repository
+from ..policy.trace import SearchContext, traced_context
+from ..proxy import ProxyManager
+from ..utils.controller import ControllerManager, ControllerParams
+from ..utils.metrics import (IDENTITY_COUNT, POLICY_COUNT,
+                             POLICY_IMPORT_ERRORS, POLICY_REVISION,
+                             PROXY_REDIRECTS, registry as metrics_registry)
+from ..utils.option import DaemonConfig, parse_option_value
+from ..utils.trigger import Trigger
+from ..compiler.lpm import ipv4_to_u32
+
+
+class Daemon:
+    """One agent instance."""
+
+    def __init__(self, config: Optional[DaemonConfig] = None,
+                 kvstore_backend=None, node_name: str = "node-local",
+                 builders: int = 4):
+        self.config = config or DaemonConfig()
+        self.node_name = node_name
+        self.repo = Repository()
+        self.ipcache = IPCache()
+        self.monitor = MonitorHub()
+        self.proxy = ProxyManager(self.config.proxy_port_min,
+                                  self.config.proxy_port_max)
+        self.controllers = ControllerManager()
+        self.datapath = Datapath(ct_slots=self.config.ct_slots)
+        self.dns_cache = DNSCache()
+        self.dns_poller: Optional[DNSPoller] = None
+        self.started_at = time.time()
+
+        # identity allocation: distributed when a kvstore is attached
+        # (daemon.go:1295 InitIdentityAllocator)
+        self.kv = kvstore_backend
+        if self.kv is not None:
+            self.identity_allocator = DistributedIdentityAllocator(
+                self.kv, node=node_name,
+                cluster_id=self.config.cluster_id)
+            self._ip_syncer = KVStoreIPCacheSyncer(self.kv)
+            self.ipcache.add_listener(self._ip_syncer.listener(),
+                                      replay=False)
+            self._ip_watcher = IPIdentityWatcher(self.kv, self.ipcache)
+            self._ip_watcher.start()
+            self.node_registry = NodeRegistry(
+                self.kv,
+                on_node_update=self._on_node_update,
+                on_node_delete=self._on_node_delete)
+        else:
+            self.identity_allocator = LocalIdentityAllocator(
+                cluster_id=self.config.cluster_id)
+            self._ip_syncer = None
+            self._ip_watcher = None
+            self.node_registry = None
+        self.node_manager = NodeManager(
+            f"{self.config.cluster_name}/{node_name}",
+            ipcache=self.ipcache,
+            mode="tunnel" if self.config.tunnel != "disabled" else "direct")
+        self.clustermesh = ClusterMesh(
+            ipcache=self.ipcache,
+            on_node_update=self.node_manager.node_updated,
+            on_node_delete=self.node_manager.node_deleted)
+
+        # policy-held CIDR identities: prefix -> (Identity, refcount);
+        # refs are PER RULE occurrence so partial deletes balance
+        self._cidr_idents: Dict[str, Tuple[Identity, int]] = {}
+        # rule object -> prefixes it currently holds refs for
+        self._rule_prefixes: Dict[int, List[str]] = {}
+        self._fqdn_rules: List[Rule] = []
+        self._lock = threading.RLock()
+
+        # endpoint regeneration pipeline (daemon.go:1133 builders)
+        self.endpoints = EndpointManager(
+            regenerate_fn=self._regenerate_endpoint, builders=builders)
+        self._regen_trigger = Trigger(
+            lambda reasons: self.endpoints.regenerate_all(
+                ",".join(reasons) or "policy-update"),
+            min_interval=0.01, name="policy-updates")
+
+        # ipcache churn -> datapath LPM reload, debounced
+        self._lpm_trigger = Trigger(
+            lambda _r: self.datapath.load_ipcache(
+                self.ipcache.to_lpm_prefixes()),
+            min_interval=0.01, name="ipcache-lpm")
+        self.ipcache.add_listener(
+            lambda *_a: self._lpm_trigger.trigger("ipcache"), replay=False)
+
+        # periodic CT GC (ctmap.go GC sweep analog)
+        self.controllers.update_controller(
+            "ct-gc", ControllerParams(
+                do_func=lambda: self.datapath.gc(), run_interval=5.0))
+
+    # ------------------------------------------------------------ nodes
+
+    def _on_node_update(self, node: Node) -> None:
+        self.node_manager.node_updated(node)
+
+    def _on_node_delete(self, full_name: str) -> None:
+        self.node_manager.node_deleted(full_name)
+
+    def register_node(self, ipv4: str, pod_cidr: str) -> Node:
+        """Publish this node (pkg/node/store.go:60)."""
+        from ..node.node import NodeAddress
+        node = Node(name=self.node_name,
+                    cluster=self.config.cluster_name,
+                    cluster_id=self.config.cluster_id,
+                    addresses=[NodeAddress(type="InternalIP", ip=ipv4)],
+                    ipv4_alloc_cidr=pod_cidr)
+        if self.node_registry is not None:
+            self.node_registry.register_local(node)
+        return node
+
+    # ----------------------------------------------------------- policy
+
+    def policy_add(self, rules: Sequence[Rule],
+                   replace: bool = False) -> int:
+        """Import rules (daemon/policy.go:171 PolicyAdd): mark/register
+        ToFQDNs rules, allocate CIDR identities + ipcache entries for
+        referenced prefixes (one ref per rule occurrence), insert into
+        the repo, trigger regeneration.
+        """
+        try:
+            for r in rules:
+                r.sanitize()
+        except Exception:
+            POLICY_IMPORT_ERRORS.inc()
+            raise
+        # FQDN rules: register with the poller; DNS changes re-inject
+        # ToCIDRSet and retrigger regeneration (pkg/fqdn/helpers.go:45)
+        for r in rules:
+            if self._rule_has_fqdn(r):
+                with self._lock:
+                    self._fqdn_rules.append(r)
+                if self.dns_poller is not None:
+                    self.dns_poller.register_rule(r)
+                inject_to_cidr_set(r, self.dns_cache)
+
+        with self._lock:
+            if replace:
+                for r in rules:
+                    if len(r.labels):
+                        self._forget_rules(self.repo.search(r.labels))
+                        self.repo.delete_by_labels(r.labels)
+            for r in rules:
+                prefixes = self._rule_cidr_prefixes(r)
+                self._retain_prefixes(prefixes)
+                self._rule_prefixes[id(r)] = prefixes
+            rev = self.repo.add_list(list(rules))
+        POLICY_COUNT.set(len(self.repo))
+        POLICY_REVISION.set(rev)
+        self.trigger_policy_updates("policy-add")
+        return rev
+
+    def policy_delete(self, labels) -> Tuple[int, int]:
+        """daemon/policy.go PolicyDelete: drop rules, release their CIDR
+        identity refs, deregister their FQDN state."""
+        with self._lock:
+            doomed = self.repo.search(labels) if len(labels) else \
+                self.repo.rules
+            rev, deleted = self.repo.delete_by_labels(labels)
+            if deleted:
+                self._forget_rules(doomed)
+        POLICY_COUNT.set(len(self.repo))
+        POLICY_REVISION.set(rev)
+        if deleted:
+            self.trigger_policy_updates("policy-delete")
+        return rev, deleted
+
+    def _forget_rules(self, doomed: Sequence[Rule]) -> None:
+        """Release per-rule CIDR refs + FQDN registration (lock held)."""
+        doomed_ids = {id(r) for r in doomed}
+        for r in doomed:
+            self._release_prefixes(
+                self._rule_prefixes.pop(id(r), None) or
+                self._rule_cidr_prefixes(r))
+        self._fqdn_rules = [r for r in self._fqdn_rules
+                            if id(r) not in doomed_ids]
+
+    def _retain_prefixes(self, prefixes: Sequence[str]) -> None:
+        """One ref per occurrence (lock held)."""
+        for p in prefixes:
+            if p in self._cidr_idents:
+                ident, n = self._cidr_idents[p]
+                self._cidr_idents[p] = (ident, n + 1)
+            else:
+                allocated = allocate_cidr_identities(
+                    self.identity_allocator, self.ipcache, [p])
+                self._cidr_idents[p] = (allocated[p], 1)
+
+    def _release_prefixes(self, prefixes: Sequence[str]) -> None:
+        for p in prefixes:
+            ident, n = self._cidr_idents.get(p, (None, 0))
+            if ident is None:
+                continue
+            if n <= 1:
+                release_cidr_identities(
+                    self.identity_allocator, self.ipcache, {p: ident})
+                del self._cidr_idents[p]
+            else:
+                self._cidr_idents[p] = (ident, n - 1)
+
+    @staticmethod
+    def _rule_has_fqdn(rule: Rule) -> bool:
+        return any(getattr(eg, "to_fqdns", None) for eg in rule.egress)
+
+    @staticmethod
+    def _rule_cidr_prefixes(rule: Rule) -> List[str]:
+        """Every CIDR prefix one rule references (incl. FQDN-injected
+        to_cidr_set entries)."""
+        out: List[str] = []
+        for ing in rule.ingress:
+            out.extend(c for c in getattr(ing, "from_cidr", []) or [])
+            out.extend(c.cidr for c in
+                       getattr(ing, "from_cidr_set", []) or [])
+        for eg in rule.egress:
+            out.extend(c for c in getattr(eg, "to_cidr", []) or [])
+            out.extend(c.cidr for c in
+                       getattr(eg, "to_cidr_set", []) or [])
+        return sorted(set(out))
+
+    def trigger_policy_updates(self, reason: str) -> None:
+        """daemon/policy.go:48 TriggerPolicyUpdates."""
+        self._regen_trigger.trigger(reason)
+
+    def policy_get(self, labels=None) -> Dict:
+        from ..policy.jsonio import rule_to_dict
+        rules = self.repo.search(labels) if labels else self.repo.rules
+        return {"revision": self.repo.revision,
+                "policy": [rule_to_dict(r) for r in rules]}
+
+    def policy_resolve(self, from_labels, to_labels,
+                       dports=None, verbose: bool = False) -> Dict:
+        """GET /policy/resolve (daemon/policy.go:67): traced verdict."""
+        from ..policy.trace import Port
+        ports = [Port(port=p, protocol="TCP") if isinstance(p, int) else p
+                 for p in (dports or [])]
+        ctx = traced_context(from_labels=from_labels, to_labels=to_labels,
+                             dports=ports, verbose=verbose)
+        verdict = self.repo.allows_ingress(ctx)
+        return {"verdict": str(verdict), "trace": ctx.trace_output()}
+
+    # -------------------------------------------------- regeneration
+
+    def _regenerate_endpoint(self, ep: Endpoint) -> None:
+        """The per-endpoint build (endpoint/policy.go regenerate tail):
+        resolve policy, allocate redirects, diff, swap device tables."""
+        cache = IdentityCache.snapshot(self.identity_allocator)
+        res = ep.regenerate_policy(
+            self.repo, cache, proxy=self.proxy,
+            always_allow_localhost=self.config.always_allow_localhost())
+        ep.apply_regeneration(res)
+        PROXY_REDIRECTS.set(len(self.proxy))
+        self._reload_datapath_policy()
+        if self.config.state_dir:
+            try:
+                ep.write_checkpoint(self.config.state_dir)
+            except OSError:
+                pass
+
+    def _reload_datapath_policy(self) -> None:
+        """Stack all endpoints' realized states into the datapath
+        (policy table swap; revision = repo revision)."""
+        eps = sorted(self.endpoints.endpoints(), key=lambda e: e.id)
+        with self._lock:
+            slot_states = [ep.realized for ep in eps]
+            for slot, ep in enumerate(eps):
+                ep.table_slot = slot
+            self.datapath.load_policy(
+                slot_states, revision=self.repo.revision,
+                ipcache_prefixes=self.ipcache.to_lpm_prefixes())
+
+    # -------------------------------------------------- endpoints
+
+    def endpoint_create(self, endpoint_id: int, ipv4: str = "",
+                        container_name: str = "",
+                        labels: Optional[Sequence[str]] = None
+                        ) -> Endpoint:
+        """PUT /endpoint/{id} (daemon/endpoint.go + CNI ADD path):
+        allocate identity, publish ip->identity, queue first build."""
+        ep = Endpoint(endpoint_id, ipv4=ipv4,
+                      container_name=container_name,
+                      opts=self.config.opts.fork())
+        self.endpoints.insert(ep)
+        ep.update_labels(self.identity_allocator,
+                         Labels.from_model(list(labels or [])))
+        IDENTITY_COUNT.set(len(self.identity_allocator))
+        if ipv4:
+            self.ipcache.upsert(ipv4, ep.security_identity,
+                                SOURCE_AGENT_LOCAL,
+                                metadata=f"endpoint:{endpoint_id}")
+        self.endpoints.queue_regeneration(endpoint_id)
+        return ep
+
+    def endpoint_delete(self, endpoint_id: int) -> bool:
+        ep = self.endpoints.remove(endpoint_id)
+        if ep is None:
+            return False
+        ep.set_state(EndpointState.DISCONNECTING, "delete")
+        if ep.ipv4:
+            self.ipcache.delete(ep.ipv4, SOURCE_AGENT_LOCAL)
+        for rid in list(ep.proxy_redirects):
+            self.proxy.remove_redirect(rid)
+        ep.proxy_redirects = {}
+        if ep.identity is not None:
+            self.identity_allocator.release(ep.identity)
+            IDENTITY_COUNT.set(len(self.identity_allocator))
+        ep.set_state(EndpointState.DISCONNECTED, "delete")
+        if self.config.state_dir:
+            try:
+                os.remove(os.path.join(self.config.state_dir,
+                                       f"ep_{endpoint_id}.json"))
+            except OSError:
+                pass
+        self._reload_datapath_policy()
+        return True
+
+    def endpoint_update_labels(self, endpoint_id: int,
+                               labels: Sequence[str]) -> bool:
+        """Returns True if the identity changed; raises KeyError for an
+        unknown endpoint (the REST layer 404s)."""
+        ep = self.endpoints.lookup(endpoint_id)
+        if ep is None:
+            raise KeyError(endpoint_id)
+        changed = ep.update_labels(self.identity_allocator,
+                                   Labels.from_model(list(labels)))
+        if changed:
+            if ep.ipv4:
+                self.ipcache.upsert(ep.ipv4, ep.security_identity,
+                                    SOURCE_AGENT_LOCAL,
+                                    metadata=f"endpoint:{endpoint_id}")
+            self.endpoints.queue_regeneration(endpoint_id)
+        return changed
+
+    def endpoint_config_patch(self, endpoint_id: int,
+                              changes: Dict[str, object]) -> int:
+        """PATCH /endpoint/{id}/config — option change triggers rebuild
+        (pkg/option applyOptsLocked semantics)."""
+        ep = self.endpoints.lookup(endpoint_id)
+        if ep is None:
+            raise KeyError(endpoint_id)
+        parsed = {k: parse_option_value(v) for k, v in changes.items()}
+        n = ep.opts.apply_validated(parsed)
+        if n:
+            ep.set_state(EndpointState.WAITING_TO_REGENERATE,
+                         "config change")
+            self.endpoints.queue_regeneration(endpoint_id)
+        return n
+
+    def config_patch(self, changes: Dict[str, object]) -> int:
+        """PATCH /config — daemon-wide option change regenerates all."""
+        parsed = {k: parse_option_value(v) for k, v in changes.items()}
+        n = self.config.opts.apply_validated(parsed)
+        if n:
+            for ep in self.endpoints.endpoints():
+                ep.opts.apply_validated(parsed)
+            self.trigger_policy_updates("config-change")
+        return n
+
+    # -------------------------------------------------- state restore
+
+    def restore_endpoints(self) -> int:
+        """daemon/state.go restoreOldEndpoints: reload checkpoints,
+        re-resolve identities, queue rebuilds."""
+        state_dir = self.config.state_dir
+        if not state_dir or not os.path.isdir(state_dir):
+            return 0
+        n = 0
+        for fname in sorted(os.listdir(state_dir)):
+            if not (fname.startswith("ep_") and fname.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(state_dir, fname)) as f:
+                    snap = json.load(f)
+                ep = Endpoint.restore(snap)
+            except (OSError, ValueError, KeyError):
+                continue
+            self.endpoints.insert(ep)
+            ep.update_labels(self.identity_allocator, ep.labels)
+            if ep.ipv4:
+                self.ipcache.upsert(ep.ipv4, ep.security_identity,
+                                    SOURCE_AGENT_LOCAL,
+                                    metadata=f"endpoint:{ep.id}")
+            self.endpoints.queue_regeneration(ep.id)
+            n += 1
+        return n
+
+    # -------------------------------------------------- services / lb
+
+    def service_upsert(self, vip: str, port: int,
+                       backends: Sequence[Tuple[str, int]],
+                       proto: int = 6) -> None:
+        """PUT /service (daemon/loadbalancer.go)."""
+        svc = Service(vip=ipv4_to_u32(vip), port=port, proto=proto,
+                      backends=[Backend(ipv4_to_u32(ip), p)
+                                for ip, p in backends])
+        self.datapath.lb.upsert_service(svc)
+        self.datapath.reload_services()
+
+    def service_delete(self, vip: str, port: int, proto: int = 6) -> bool:
+        ok = self.datapath.lb.delete_service(ipv4_to_u32(vip), port, proto)
+        if ok:
+            self.datapath.reload_services()
+        return ok
+
+    # -------------------------------------------------- prefilter
+
+    def prefilter_update(self, cidrs: List[str]) -> int:
+        """PATCH /prefilter (pkg/datapath/prefilter:125 Insert)."""
+        self.datapath.prefilter.insert(cidrs)
+        self.datapath.reload_prefilter()
+        return self.datapath.prefilter.revision
+
+    def prefilter_delete(self, cidrs: List[str]) -> int:
+        self.datapath.prefilter.delete(cidrs)
+        self.datapath.reload_prefilter()
+        return self.datapath.prefilter.revision
+
+    # -------------------------------------------------- identity / fqdn
+
+    def identity_get(self, numeric_id: Optional[int] = None,
+                     labels: Optional[Sequence[str]] = None
+                     ) -> Optional[Dict]:
+        if numeric_id is not None:
+            ident = self.identity_allocator.lookup_by_id(numeric_id)
+        else:
+            ident = self.identity_allocator.lookup_by_labels(
+                Labels.from_model(list(labels or [])))
+        if ident is None:
+            return None
+        return {"id": ident.id,
+                "labels": [str(l) for l in ident.label_array]}
+
+    def identity_list(self) -> List[Dict]:
+        out = [{"id": i.id, "labels": [str(l) for l in i.label_array]}
+               for i in self.identity_allocator.snapshot_identities()]
+        for num, ident in sorted(idpkg.RESERVED_IDENTITY_CACHE.items()):
+            out.append({"id": num,
+                        "labels": [str(l) for l in ident.label_array]})
+        return sorted(out, key=lambda d: d["id"])
+
+    def start_fqdn_poller(self, lookup, interval: float = 5.0) -> DNSPoller:
+        """pkg/fqdn/dnspoller.go:50 — poll loop; when any matchName's
+        IP set changes, re-inject ToCIDRSet into the registered FQDN
+        rules and retrigger regeneration. ``lookup(names)`` returns
+        {name: (ips, ttl)}."""
+        def on_change(changed_names) -> None:
+            dirty = False
+            with self._lock:
+                for r in self._fqdn_rules:
+                    old = self._rule_prefixes.get(id(r), [])
+                    inject_to_cidr_set(r, self.dns_cache)
+                    new = self._rule_cidr_prefixes(r)
+                    if new != old:
+                        # newly resolved IPs need identities + ipcache
+                        # entries or their CIDR labels never match
+                        old_set, new_set = set(old), set(new)
+                        self._retain_prefixes(sorted(new_set - old_set))
+                        self._release_prefixes(sorted(old_set - new_set))
+                        self._rule_prefixes[id(r)] = new
+                        dirty = True
+            if dirty:
+                self.trigger_policy_updates("fqdn-update")
+
+        self.dns_poller = DNSPoller(self.dns_cache, lookup=lookup,
+                                    on_change=on_change, interval=interval)
+        with self._lock:
+            for r in self._fqdn_rules:
+                self.dns_poller.register_rule(r)
+        self.dns_poller.start()
+        return self.dns_poller
+
+    # -------------------------------------------------- status
+
+    def status(self) -> Dict:
+        """GET /healthz (daemon/status.go status collector)."""
+        kv = "ok" if self.kv is None else self.kv.status()
+        return {
+            "uptime-seconds": round(time.time() - self.started_at, 3),
+            "kvstore": {"state": kv,
+                        "backend": "none" if self.kv is None else
+                        type(self.kv).__name__},
+            "policy": {"revision": self.repo.revision,
+                       "rules": len(self.repo)},
+            "endpoints": {
+                "total": len(self.endpoints),
+                "by-state": self._endpoint_state_counts()},
+            "identities": len(self.identity_allocator),
+            "ipcache": len(self.ipcache),
+            "nodes": len(self.node_manager),
+            "proxy": {"redirects": len(self.proxy)},
+            "clustermesh": self.clustermesh.status(),
+            "controllers": self.controllers.status_model(),
+            "datapath": {"revision": self.datapath.revision,
+                         "conntrack-slots": self.datapath.ct.slots},
+        }
+
+    def _endpoint_state_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for ep in self.endpoints.endpoints():
+            counts[ep.state] = counts.get(ep.state, 0) + 1
+        return counts
+
+    def metrics_text(self) -> str:
+        return metrics_registry.expose_text()
+
+    # -------------------------------------------------- lifecycle
+
+    def wait_for_quiesce(self, timeout: float = 30.0) -> bool:
+        return self.endpoints.wait_for_quiesce(timeout)
+
+    def wait_for_policy_revision(self, revision: Optional[int] = None,
+                                 timeout: float = 30.0) -> bool:
+        """Block until every live endpoint has applied ``revision``
+        (default: the current repo revision) and the build queue is
+        idle. The synchronous wait the async TriggerPolicyUpdates path
+        needs (the reference tracks the same via Endpoint.policyRevision
+        waitForPolicyRevision)."""
+        rev = revision if revision is not None else self.repo.revision
+        deadline = time.time() + timeout
+
+        def applied() -> bool:
+            return all(ep.policy_revision >= rev or
+                       ep.state in (EndpointState.DISCONNECTING,
+                                    EndpointState.DISCONNECTED)
+                       for ep in self.endpoints.endpoints())
+
+        while time.time() < deadline:
+            if applied() and self.endpoints.wait_for_quiesce(0.05):
+                return True
+            time.sleep(0.01)
+        return applied() and self.endpoints.wait_for_quiesce(0.0)
+
+    def shutdown(self) -> None:
+        self.endpoints.shutdown()
+        self._regen_trigger.shutdown()
+        self._lpm_trigger.shutdown()
+        self.controllers.remove_all()
+        self.clustermesh.close()
+        if self.dns_poller is not None:
+            self.dns_poller.stop()
+        if self._ip_watcher is not None:
+            self._ip_watcher.stop()
+        if self.node_registry is not None:
+            self.node_registry.close()

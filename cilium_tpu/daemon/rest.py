@@ -1,0 +1,257 @@
+"""REST API for the daemon.
+
+Mirrors the reference's OpenAPI surface (api/v1/openapi.yaml) core
+paths: /healthz, /config, /policy, /policy/resolve, /endpoint,
+/endpoint/{id}, /endpoint/{id}/config, /identity, /identity/{id},
+/service, /prefilter, plus /metrics (Prometheus text) and /monitor
+(event tail). Stdlib http.server — the reference serves REST over a
+unix socket; here TCP on localhost for the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..labels import LabelArray, parse_label
+from ..policy.api import PolicyError
+from ..policy.jsonio import rules_from_json
+from .daemon import Daemon
+
+
+class _Handler(BaseHTTPRequestHandler):
+    daemon: Daemon = None  # set by make_server
+    protocol_version = "HTTP/1.1"
+
+    # silence default request logging
+    def log_message(self, *args):
+        pass
+
+    # ------------------------------------------------------------ helpers
+
+    def _send(self, code: int, body, content_type="application/json"):
+        data = body if isinstance(body, bytes) else \
+            json.dumps(body, indent=1, sort_keys=True).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, code: int, msg: str):
+        self._send(code, {"error": msg})
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _labels_from_query(self, qs) -> Optional[LabelArray]:
+        raw = qs.get("labels", [])
+        if not raw:
+            return None
+        return LabelArray(parse_label(s) for s in raw)
+
+    # ------------------------------------------------------------ routing
+
+    def _route(self, method: str):
+        d = self.daemon
+        url = urlparse(self.path)
+        path = url.path.rstrip("/") or "/"
+        qs = parse_qs(url.query)
+        try:
+            if path == "/healthz" and method == "GET":
+                return self._send(200, d.status())
+            if path == "/metrics" and method == "GET":
+                return self._send(200, d.metrics_text().encode(),
+                                  "text/plain; version=0.0.4")
+            if path == "/config":
+                if method == "GET":
+                    return self._send(200, {
+                        "daemon": d.config.opts.dump(),
+                        "cluster": {"name": d.config.cluster_name,
+                                    "id": d.config.cluster_id}})
+                if method == "PATCH":
+                    changes = json.loads(self._body() or b"{}")
+                    return self._send(200,
+                                      {"changed": d.config_patch(changes)})
+            if path == "/policy":
+                if method == "GET":
+                    return self._send(
+                        200, d.policy_get(self._labels_from_query(qs)))
+                if method in ("PUT", "POST"):
+                    rules = rules_from_json(self._body())
+                    rev = d.policy_add(rules)
+                    return self._send(200, {"revision": rev})
+                if method == "DELETE":
+                    labels = self._labels_from_query(qs) or LabelArray()
+                    rev, deleted = d.policy_delete(labels)
+                    return self._send(200, {"revision": rev,
+                                            "deleted": deleted})
+            if path == "/policy/resolve" and method in ("GET", "POST"):
+                body = json.loads(self._body() or b"{}")
+                frm = LabelArray.parse_select(*body.get("from", []))
+                to = LabelArray.parse_select(*body.get("to", []))
+                return self._send(200, d.policy_resolve(
+                    frm, to, dports=body.get("dports"),
+                    verbose=bool(body.get("verbose"))))
+            if path == "/endpoint" and method == "GET":
+                return self._send(200, [ep.model()
+                                        for ep in d.endpoints.endpoints()])
+            m = re.fullmatch(r"/endpoint/(\d+)", path)
+            if m:
+                ep_id = int(m.group(1))
+                if method == "PUT":
+                    body = json.loads(self._body() or b"{}")
+                    if d.endpoints.lookup(ep_id) is not None:
+                        return self._error(409, "endpoint exists")
+                    ep = d.endpoint_create(
+                        ep_id, ipv4=body.get("ipv4", ""),
+                        container_name=body.get("container-name", ""),
+                        labels=body.get("labels", []))
+                    return self._send(201, ep.model())
+                if method == "GET":
+                    ep = d.endpoints.lookup(ep_id)
+                    if ep is None:
+                        return self._error(404, "endpoint not found")
+                    return self._send(200, ep.model())
+                if method == "DELETE":
+                    if not d.endpoint_delete(ep_id):
+                        return self._error(404, "endpoint not found")
+                    return self._send(200, {"deleted": ep_id})
+                if method == "PATCH":
+                    body = json.loads(self._body() or b"{}")
+                    if "labels" in body:
+                        try:
+                            changed = d.endpoint_update_labels(
+                                ep_id, body["labels"])
+                        except KeyError:
+                            return self._error(404, "endpoint not found")
+                        return self._send(200, {"ok": True,
+                                                "changed": changed})
+                    return self._error(400, "nothing to patch")
+            m = re.fullmatch(r"/endpoint/(\d+)/config", path)
+            if m and method == "PATCH":
+                changes = json.loads(self._body() or b"{}")
+                try:
+                    n = d.endpoint_config_patch(int(m.group(1)), changes)
+                except KeyError:
+                    return self._error(404, "endpoint not found")
+                return self._send(200, {"changed": n})
+            if path == "/identity" and method == "GET":
+                labels = qs.get("labels")
+                if labels:
+                    ident = d.identity_get(labels=labels)
+                    if ident is None:
+                        return self._error(404, "identity not found")
+                    return self._send(200, ident)
+                return self._send(200, d.identity_list())
+            m = re.fullmatch(r"/identity/(\d+)", path)
+            if m and method == "GET":
+                ident = d.identity_get(numeric_id=int(m.group(1)))
+                if ident is None:
+                    return self._error(404, "identity not found")
+                return self._send(200, ident)
+            if path == "/service":
+                if method == "GET":
+                    return self._send(200, _service_dump(d))
+                if method == "PUT":
+                    body = json.loads(self._body() or b"{}")
+                    d.service_upsert(
+                        body["vip"], int(body["port"]),
+                        [(b["ip"], int(b["port"]))
+                         for b in body.get("backends", [])],
+                        proto=int(body.get("proto", 6)))
+                    return self._send(200, {"ok": True})
+                if method == "DELETE":
+                    body = json.loads(self._body() or b"{}")
+                    ok = d.service_delete(body["vip"], int(body["port"]),
+                                          proto=int(body.get("proto", 6)))
+                    return self._send(200 if ok else 404, {"deleted": ok})
+            if path == "/prefilter":
+                if method == "GET":
+                    cidrs, rev = d.datapath.prefilter.dump()
+                    return self._send(200, {"cidrs": cidrs,
+                                            "revision": rev})
+                if method == "PATCH":
+                    body = json.loads(self._body() or b"{}")
+                    rev = d.prefilter_update(body.get("cidrs", []))
+                    return self._send(200, {"revision": rev})
+                if method == "DELETE":
+                    body = json.loads(self._body() or b"{}")
+                    rev = d.prefilter_delete(body.get("cidrs", []))
+                    return self._send(200, {"revision": rev})
+            if path == "/monitor" and method == "GET":
+                n = int(qs.get("n", ["100"])[0])
+                drops = qs.get("drops", ["false"])[0] == "true"
+                events = d.monitor.tail(n, drops_only=drops)
+                return self._send(200, [
+                    {"timestamp": e.timestamp, "code": e.code,
+                     "endpoint": e.endpoint, "identity": e.identity,
+                     "dport": e.dport, "proto": e.proto,
+                     "length": e.length, "message": e.describe()}
+                    for e in events])
+            if path == "/monitor/stats" and method == "GET":
+                return self._send(200, d.monitor.stats())
+            return self._error(404, f"no route for {method} {path}")
+        except PolicyError as exc:
+            return self._error(400, str(exc))
+        except (ValueError, KeyError) as exc:
+            return self._error(400, f"bad request: {exc}")
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_PUT(self):
+        self._route("PUT")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+    def do_PATCH(self):
+        self._route("PATCH")
+
+
+def _u32_to_ipv4(v: int) -> str:
+    return ".".join(str((v >> s) & 0xFF) for s in (24, 16, 8, 0))
+
+
+def _service_dump(d: Daemon):
+    out = []
+    for svc in d.datapath.lb.services():
+        out.append({"vip": _u32_to_ipv4(svc.vip), "port": svc.port,
+                    "proto": svc.proto,
+                    "backends": [{"ip": _u32_to_ipv4(b.addr),
+                                  "port": b.port} for b in svc.backends]})
+    return out
+
+
+class APIServer:
+    """Threaded REST server bound to localhost."""
+
+    def __init__(self, daemon: Daemon, host: str = "127.0.0.1",
+                 port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"daemon": daemon})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="api-server")
+
+    def start(self) -> "APIServer":
+        self._thread.start()
+        return self
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5)

@@ -127,6 +127,10 @@ def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
 
     def addr(x):
         a = _np.asarray(x)
+        if a.dtype.kind in ("U", "S", "O"):  # dotted-quad strings
+            from ..compiler.lpm import ipv4_to_u32
+            a = _np.array([ipv4_to_u32(str(s)) for s in a.ravel()],
+                          _np.uint32).reshape(a.shape)
         if a.dtype == _np.uint32:
             a = a.view(_np.int32)
         return jnp.asarray(a.astype(_np.int32) if a.dtype != _np.int32 else a)

@@ -199,6 +199,10 @@ class LoadBalancer:
     def __len__(self):
         return len(self._services)
 
+    def services(self) -> List[Service]:
+        return sorted(self._services.values(),
+                      key=lambda s: (s.vip, s.port, s.proto))
+
     def step(self, daddr, dport, proto, saddr, sport):
         if self.compiled is None:
             self._recompile()

@@ -1,0 +1,149 @@
+"""Monitor: datapath event aggregation + subscriber fan-out.
+
+Reference: monitor/ + pkg/monitor — BPF programs emit DropNotify/
+TraceNotify into a perf ring; cilium-node-monitor consumes it and fans
+out to subscribers over unix sockets (monitor/main.go:81-119), with
+decoders in pkg/monitor/datapath_{drop,trace}.go. Here the batched
+datapath returns one event code per packet; the hub aggregates counts
+(metricsmap analog), keeps a bounded sample ring, and fans decoded
+samples out to in-process subscribers (the CLI's ``monitor`` command).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .datapath.events import DROP_NAMES, TRACE_NAMES
+from .utils.metrics import DROP_COUNT, FORWARD_COUNT
+
+
+@dataclass(frozen=True)
+class MonitorEvent:
+    """One decoded sample (DropNotify/TraceNotify analog)."""
+
+    timestamp: float
+    code: int            # trace point (>=0) or drop reason (<0)
+    endpoint: int
+    identity: int
+    dport: int
+    proto: int
+    length: int
+
+    @property
+    def is_drop(self) -> bool:
+        return self.code < 0
+
+    def describe(self) -> str:
+        name = DROP_NAMES.get(self.code) or TRACE_NAMES.get(self.code) or \
+            f"code {self.code}"
+        kind = "DROP" if self.is_drop else "TRACE"
+        return (f"{kind} ep={self.endpoint} identity={self.identity} "
+                f"dport={self.dport} proto={self.proto} "
+                f"len={self.length}: {name}")
+
+
+class MonitorHub:
+    """Aggregate + sample + fan out datapath events."""
+
+    def __init__(self, ring_capacity: int = 4096,
+                 samples_per_batch: int = 16):
+        self.ring_capacity = ring_capacity
+        self.samples_per_batch = samples_per_batch
+        self._lock = threading.Lock()
+        self._ring: List[MonitorEvent] = []
+        self._counts: Dict[int, int] = {}
+        self._bytes: Dict[int, int] = {}
+        self._subscribers: List[Callable[[MonitorEvent], None]] = []
+        self.lost = 0  # samples not ringed (perf-ring lost-events analog)
+
+    # ------------------------------------------------------------ ingest
+
+    def ingest_batch(self, event_codes, endpoints, identities, dports,
+                     protos, lengths) -> None:
+        """Aggregate one datapath batch (all args array-like [B])."""
+        codes = np.asarray(event_codes)
+        eps = np.asarray(endpoints)
+        ids = np.asarray(identities)
+        dps = np.asarray(dports)
+        prs = np.asarray(protos)
+        lns = np.asarray(lengths)
+        now = time.time()
+
+        uniq, cnt = np.unique(codes, return_counts=True)
+        drop_bytes: Dict[int, int] = {}
+        for code, n in zip(uniq.tolist(), cnt.tolist()):
+            drop_bytes[code] = int(lns[codes == code].sum())
+            if code < 0:
+                DROP_COUNT.inc(n, labels={
+                    "reason": DROP_NAMES.get(code, str(code))})
+            else:
+                FORWARD_COUNT.inc(n)
+
+        # bounded sampling: first K drops + first K traces per batch
+        samples: List[MonitorEvent] = []
+        for want_drop in (True, False):
+            mask = codes < 0 if want_drop else codes >= 0
+            idx = np.flatnonzero(mask)[:self.samples_per_batch]
+            for i in idx.tolist():
+                samples.append(MonitorEvent(
+                    timestamp=now, code=int(codes[i]), endpoint=int(eps[i]),
+                    identity=int(ids[i]), dport=int(dps[i]),
+                    proto=int(prs[i]), length=int(lns[i])))
+        with self._lock:
+            for code, n in zip(uniq.tolist(), cnt.tolist()):
+                self._counts[code] = self._counts.get(code, 0) + int(n)
+                self._bytes[code] = self._bytes.get(code, 0) + \
+                    drop_bytes[code]
+            self._ring.extend(samples)
+            if len(self._ring) > self.ring_capacity:
+                self._ring = self._ring[-self.ring_capacity:]
+            self.lost += max(0, int(codes.shape[0]) - len(samples))
+            subs = list(self._subscribers)
+        for fn in subs:
+            for ev in samples:
+                fn(ev)
+
+    # --------------------------------------------------------- consumers
+
+    def subscribe(self, fn: Callable[[MonitorEvent], None]) -> Callable:
+        """Register a subscriber; returns an unsubscribe closure
+        (monitor/main.go fan-out analog)."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+        def unsubscribe():
+            with self._lock:
+                if fn in self._subscribers:
+                    self._subscribers.remove(fn)
+        return unsubscribe
+
+    def tail(self, n: int = 100,
+             drops_only: bool = False) -> List[MonitorEvent]:
+        with self._lock:
+            ring = list(self._ring)
+        if drops_only:
+            ring = [e for e in ring if e.is_drop]
+        return ring[-n:]
+
+    def stats(self) -> Dict[str, Dict]:
+        """metricsmap-style dump: per-code packet/byte totals."""
+        with self._lock:
+            out = {}
+            for code, n in sorted(self._counts.items()):
+                name = DROP_NAMES.get(code) or TRACE_NAMES.get(code) or \
+                    str(code)
+                out[name] = {"code": code, "packets": n,
+                             "bytes": self._bytes.get(code, 0)}
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring = []
+            self._counts = {}
+            self._bytes = {}
+            self.lost = 0

@@ -1,0 +1,436 @@
+"""Daemon + REST API + CLI integration tests.
+
+The e2e tier analog of the reference's test/runtime suite: a full agent
+in-process, driven through the REST surface and the CLI, down to device
+verdicts.
+"""
+
+import io
+import json
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.cli import Client, main as cli_main
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.daemon.rest import APIServer
+from cilium_tpu.datapath.engine import make_full_batch
+from cilium_tpu.kvstore.memory import InMemoryBackend, MemStore
+from cilium_tpu.policy.jsonio import (rule_from_dict, rule_to_dict,
+                                      rules_from_json, rules_to_json)
+from cilium_tpu.utils.option import DaemonConfig
+
+
+RULES_JSON = """
+[{
+  "endpointSelector": {"matchLabels": {"id": "server"}},
+  "ingress": [
+    {"fromEndpoints": [{"matchLabels": {"id": "client"}}]},
+    {"toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}],
+                  "rules": {"http": [{"method": "GET", "path": "/public.*"}]}}]}
+  ],
+  "labels": ["k8s:policy=web"]
+}]
+"""
+
+
+@pytest.fixture
+def agent(tmp_path):
+    cfg = DaemonConfig(state_dir=str(tmp_path / "state"))
+    d = Daemon(config=cfg, builders=4)
+    server = APIServer(d).start()
+    yield d, server
+    server.shutdown()
+    d.shutdown()
+
+
+def _wait(fn, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return fn()
+
+
+def _cli(server, *argv):
+    """Run the CLI against the live server, capturing stdout."""
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        rc = cli_main(["--api", server.base_url, *argv])
+    finally:
+        sys.stdout = old
+    return rc, out.getvalue()
+
+
+# ----------------------------------------------------------- JSON round-trip
+
+def test_rule_json_roundtrip():
+    rules = rules_from_json(RULES_JSON)
+    assert len(rules) == 1
+    r = rules[0]
+    assert r.ingress[0].from_endpoints[0].matches.__self__ is not None
+    text = rules_to_json(rules)
+    again = rules_from_json(text)
+    assert rule_to_dict(again[0]) == rule_to_dict(r)
+    # single object (not list) also accepted
+    single = rules_from_json(json.dumps(rule_to_dict(r)))
+    assert len(single) == 1
+
+
+# ------------------------------------------------------------------ agent
+
+def test_agent_end_to_end_policy_enforcement(agent):
+    d, server = agent
+    c = Client(server.base_url)
+
+    # create endpoints over REST
+    srv = c.put("/endpoint/100", {"ipv4": "10.0.0.10",
+                                  "container-name": "web",
+                                  "labels": ["k8s:id=server"]})
+    assert srv["state"] in ("ready", "waiting-to-regenerate",
+                            "regenerating", "not-ready")
+    c.put("/endpoint/200", {"ipv4": "10.0.0.20",
+                            "labels": ["k8s:id=client"]})
+    c.put("/endpoint/300", {"ipv4": "10.0.0.30",
+                            "labels": ["k8s:id=stranger"]})
+    with pytest.raises(SystemExit):
+        c.put("/endpoint/100", {})  # duplicate -> 409
+
+    # import policy
+    rev = c.request("PUT", "/policy", json.loads(RULES_JSON))
+    assert rev["revision"] >= 2
+    assert d.wait_for_policy_revision()
+
+    # identities allocated & visible
+    idents = c.get("/identity")
+    by_labels = {tuple(i["labels"]): i["id"] for i in idents}
+    client_id = by_labels[("k8s:id=client",)]
+    stranger_id = by_labels[("k8s:id=stranger",)]
+
+    # device verdicts: client allowed (L3), stranger on 80 allowed (L4
+    # wildcard w/ proxy), stranger on 22 dropped
+    server_ep = d.endpoints.lookup(100)
+    slot = server_ep.table_slot
+    batch = make_full_batch(
+        endpoint=[slot, slot, slot],
+        saddr=["10.0.0.20", "10.0.0.30", "10.0.0.30"],
+        daddr=["10.0.0.10"] * 3,
+        sport=[40000, 40001, 40002], dport=[9999, 80, 22],
+        direction=[0, 0, 0])
+    verdict, event, identity, nat = d.datapath.process(batch)
+    v = np.asarray(verdict)
+    assert v[0] == 0          # client L3 allow
+    assert v[1] > 0           # proxy redirect port for HTTP rule
+    assert v[2] < 0           # stranger:22 dropped
+    ids = np.asarray(identity)
+    assert ids[0] == client_id and ids[1] == stranger_id
+
+    # monitor ingests the batch
+    d.monitor.ingest_batch(np.asarray(event), np.asarray(batch.endpoint),
+                           ids, np.asarray(batch.dport),
+                           np.asarray(batch.proto),
+                           np.asarray(batch.length))
+    stats = c.get("/monitor/stats")
+    assert any("Policy denied" in k for k in stats)
+    drops = c.get("/monitor?drops=true")
+    assert drops and all(e["code"] < 0 for e in drops)
+
+    # policy trace explains the drop
+    out = c.post("/policy/resolve", {"from": ["id=stranger"],
+                                     "to": ["id=server"]})
+    assert out["verdict"] == "denied"
+    assert "Tracing" in out["trace"]
+
+    # established flows keep their CT verdict even after policy delete
+    # (reference: only CT_NEW packets hit the policy stage)
+    c.delete("/policy")
+    assert d.wait_for_policy_revision()
+    verdict, *_ = d.datapath.process(batch)
+    v2 = np.asarray(verdict)
+    assert v2[0] == 0 and v2[1] > 0
+    # ...but NEW flows (fresh source ports) now drop by default
+    fresh = make_full_batch(
+        endpoint=[slot, slot, slot],
+        saddr=["10.0.0.20", "10.0.0.30", "10.0.0.30"],
+        daddr=["10.0.0.10"] * 3,
+        sport=[50000, 50001, 50002], dport=[9999, 80, 22],
+        direction=[0, 0, 0])
+    verdict, *_ = d.datapath.process(fresh)
+    assert (np.asarray(verdict) < 0).all()
+
+
+def test_agent_restore_from_checkpoint(tmp_path):
+    state = str(tmp_path / "state")
+    cfg = DaemonConfig(state_dir=state)
+    d1 = Daemon(config=cfg)
+    d1.endpoint_create(7, ipv4="10.0.0.7", labels=["k8s:app=db"])
+    assert d1.wait_for_quiesce(10)
+    d1.shutdown()
+
+    d2 = Daemon(config=DaemonConfig(state_dir=state))
+    n = d2.restore_endpoints()
+    assert n == 1
+    assert d2.wait_for_quiesce(10)
+    ep = d2.endpoints.lookup(7)
+    assert ep.ipv4 == "10.0.0.7"
+    assert ep.security_identity >= 256
+    assert d2.ipcache.lookup_by_ip("10.0.0.7") == ep.security_identity
+    d2.shutdown()
+
+
+def test_agent_with_kvstore_replicates(tmp_path):
+    """Two agents sharing a kvstore converge on identities + ipcache."""
+    store = MemStore()
+    d1 = Daemon(config=DaemonConfig(),
+                kvstore_backend=InMemoryBackend(store), node_name="n1")
+    d2 = Daemon(config=DaemonConfig(),
+                kvstore_backend=InMemoryBackend(store), node_name="n2")
+    ep = d1.endpoint_create(1, ipv4="10.1.0.5", labels=["k8s:app=web"])
+    assert d1.wait_for_quiesce(10)
+    # same labels on the other node -> same identity id
+    ident2, _ = d2.identity_allocator.allocate(
+        __import__("cilium_tpu.labels", fromlist=["Labels"]).Labels
+        .from_model(["k8s:app=web"]))
+    assert ident2.id == ep.security_identity
+    # ip->identity replicated into agent 2's ipcache
+    assert _wait(lambda: d2.ipcache.lookup_by_ip("10.1.0.5") ==
+                 ep.security_identity)
+    d1.register_node("192.168.0.1", "10.1.0.0/16")
+    assert _wait(lambda: d2.node_manager.tunnel_endpoint_for("10.1.0.0/16")
+                 == "192.168.0.1")
+    d1.shutdown()
+    d2.shutdown()
+
+
+def test_services_and_prefilter_via_api(agent):
+    d, server = agent
+    c = Client(server.base_url)
+    c.put("/endpoint/1", {"ipv4": "10.0.0.1", "labels": ["k8s:a=b"]})
+    c.request("PUT", "/policy", json.loads(RULES_JSON))
+    assert d.wait_for_quiesce(10)
+
+    c.put("/service", {"vip": "10.96.0.1", "port": 80,
+                       "backends": [{"ip": "10.0.0.10", "port": 8080},
+                                    {"ip": "10.0.0.11", "port": 8080}]})
+    svcs = c.get("/service")
+    assert svcs[0]["vip"] == "10.96.0.1"
+    assert len(svcs[0]["backends"]) == 2
+
+    out = c.patch("/prefilter", {"cidrs": ["203.0.113.0/24"]})
+    assert out["revision"] >= 1
+    got = c.get("/prefilter")
+    assert got["cidrs"] == ["203.0.113.0/24"]
+
+    # a packet from the prefiltered range is dropped regardless of policy
+    ep = d.endpoints.lookup(1)
+    batch = make_full_batch(endpoint=[ep.table_slot],
+                            saddr=["203.0.113.7"], daddr=["10.0.0.1"],
+                            sport=[1234], dport=[80], direction=[0])
+    verdict, event, _i, _n = d.datapath.process(batch)
+    assert int(np.asarray(verdict)[0]) < 0
+
+    c.delete("/service", {"vip": "10.96.0.1", "port": 80})
+    assert c.get("/service") == []
+
+
+def test_config_patch_disables_policy(agent):
+    d, server = agent
+    c = Client(server.base_url)
+    c.put("/endpoint/5", {"ipv4": "10.0.0.5", "labels": ["k8s:x=y"]})
+    c.request("PUT", "/policy", json.loads(RULES_JSON))
+    assert d.wait_for_quiesce(10)
+    ep = d.endpoints.lookup(5)
+    batch = make_full_batch(endpoint=[ep.table_slot], saddr=["8.8.8.8"],
+                            daddr=["10.0.0.5"], sport=[1], dport=[443],
+                            direction=[0])
+    verdict, *_ = d.datapath.process(batch)
+    assert int(np.asarray(verdict)[0]) < 0  # enforced: drop
+
+    out = c.patch("/config", {"Policy": "false"})
+    assert out["changed"] >= 1
+    assert d.wait_for_policy_revision()
+    verdict, *_ = d.datapath.process(batch)
+    assert int(np.asarray(verdict)[0]) == 0  # enforcement off: allow
+
+
+# -------------------------------------------------------------------- CLI
+
+def test_cli_full_surface(agent, tmp_path):
+    d, server = agent
+    c = Client(server.base_url)
+    c.put("/endpoint/100", {"ipv4": "10.0.0.10",
+                            "container-name": "web",
+                            "labels": ["k8s:id=server"]})
+    rules_file = tmp_path / "rules.json"
+    rules_file.write_text(RULES_JSON)
+
+    rc, out = _cli(server, "policy", "import", str(rules_file))
+    assert rc == 0 and "Revision:" in out
+    assert d.wait_for_quiesce(10)
+
+    rc, out = _cli(server, "status")
+    assert rc == 0 and "Policy:" in out and "1 rules" in out
+
+    rc, out = _cli(server, "endpoint", "list")
+    assert rc == 0 and "web" in out and "ready" in out
+
+    rc, out = _cli(server, "identity", "list")
+    assert rc == 0 and "k8s:id=server" in out
+
+    rc, out = _cli(server, "policy", "trace", "--src", "id=client",
+                   "--dst", "id=server")
+    assert rc == 0 and "Final verdict: ALLOWED" in out
+
+    rc, out = _cli(server, "policy", "trace", "--src", "id=nobody",
+                   "--dst", "id=server")
+    assert rc == 1 and "Final verdict: DENIED" in out
+
+    rc, out = _cli(server, "service", "update", "--frontend",
+                   "10.96.0.1:80", "--backends", "10.0.0.10:8080")
+    assert rc == 0
+    rc, out = _cli(server, "service", "list")
+    assert "10.96.0.1:80" in out
+
+    rc, out = _cli(server, "prefilter", "update", "198.51.100.0/24")
+    assert rc == 0
+    rc, out = _cli(server, "prefilter", "list")
+    assert "198.51.100.0/24" in out
+
+    rc, out = _cli(server, "config")
+    assert rc == 0 and "Policy" in out
+    rc, out = _cli(server, "config", "Debug=true")
+    assert rc == 0 and "Changed 1" in out
+
+    rc, out = _cli(server, "metrics")
+    assert rc == 0 and "cilium_tpu_endpoint_count" in out
+
+    rc, out = _cli(server, "monitor", "--stats")
+    assert rc == 0
+
+    rc, out = _cli(server, "endpoint", "config", "100",
+                   "IngressPolicy=false")
+    assert rc == 0 and "Changed 1" in out
+
+    rc, out = _cli(server, "policy", "delete")
+    assert rc == 0 and "deleted" in out
+
+    rc, out = _cli(server, "endpoint", "delete", "100")
+    assert rc == 0
+
+
+# --------------------------------------------- review-regression coverage
+
+def test_cidr_refcount_per_rule_partial_delete():
+    """Two rules share a CIDR; deleting one must keep the identity."""
+    from cilium_tpu.policy.api import (EgressRule, EndpointSelector,
+                                       Rule)
+    from cilium_tpu.labels import LabelArray
+    d = Daemon(config=DaemonConfig())
+    es = EndpointSelector.parse
+    r_a = Rule(endpoint_selector=es("app=a"),
+               egress=[EgressRule(to_cidr=["10.9.0.0/24"])],
+               labels=LabelArray.parse("rule=a"))
+    r_b = Rule(endpoint_selector=es("app=b"),
+               egress=[EgressRule(to_cidr=["10.9.0.0/24"])],
+               labels=LabelArray.parse("rule=b"))
+    d.policy_add([r_a, r_b])
+    cidr_id = d.ipcache.lookup_by_ip("10.9.0.0/24")
+    assert cidr_id is not None
+    # delete only rule A: identity + ipcache entry survive for B
+    d.policy_delete(LabelArray.parse("rule=a"))
+    assert d.ipcache.lookup_by_ip("10.9.0.0/24") == cidr_id
+    # delete rule B: now released
+    d.policy_delete(LabelArray.parse("rule=b"))
+    assert d.ipcache.lookup_by_ip("10.9.0.0/24") is None
+    d.shutdown()
+
+
+def test_policy_replace_releases_old_refs():
+    from cilium_tpu.policy.api import EgressRule, EndpointSelector, Rule
+    from cilium_tpu.labels import LabelArray
+    d = Daemon(config=DaemonConfig())
+    es = EndpointSelector.parse
+    for _ in range(3):
+        r = Rule(endpoint_selector=es("app=a"),
+                 egress=[EgressRule(to_cidr=["10.8.0.0/24"])],
+                 labels=LabelArray.parse("rule=r"))
+        d.policy_add([r], replace=True)
+    # refcount must be exactly 1 after repeated replaces
+    assert d._cidr_idents["10.8.0.0/24"][1] == 1
+    d.policy_delete(LabelArray.parse("rule=r"))
+    assert "10.8.0.0/24" not in d._cidr_idents
+    assert d.ipcache.lookup_by_ip("10.8.0.0/24") is None
+    d.shutdown()
+
+
+def test_fqdn_new_ips_get_identities_and_old_released():
+    from cilium_tpu.policy.api import (EgressRule, EndpointSelector,
+                                       FQDNSelector, Rule)
+    from cilium_tpu.labels import LabelArray
+    d = Daemon(config=DaemonConfig())
+    resolutions = {"db.example.com": (["192.0.2.1"], 60)}
+    d.start_fqdn_poller(lambda names: {n: resolutions[n] for n in names
+                                       if n in resolutions},
+                        interval=3600)
+    r = Rule(endpoint_selector=EndpointSelector.parse("app=a"),
+             egress=[EgressRule(
+                 to_fqdns=[FQDNSelector(match_name="db.example.com")])],
+             labels=LabelArray.parse("rule=fqdn"))
+    d.policy_add([r])
+    d.dns_poller.poll_once()
+    assert d.ipcache.lookup_by_ip("192.0.2.1/32") is not None
+
+    # DNS adds an IP: the new one gets an identity too; the old one
+    # stays allowed until its TTL expires (DNSCache semantics)
+    resolutions["db.example.com"] = (["192.0.2.2"], 3600)
+    d.dns_poller.poll_once()
+    assert d.ipcache.lookup_by_ip("192.0.2.2/32") is not None
+    assert d.ipcache.lookup_by_ip("192.0.2.1/32") is not None
+
+    # after the old entry expires out of the cache, the next DNS
+    # change re-injects without it and its identity is released
+    d.dns_cache.gc(time.time() + 120)  # expires .1 (ttl 60), keeps .2
+    resolutions["db.example.com"] = (["192.0.2.2", "192.0.2.3"], 3600)
+    d.dns_poller.poll_once()
+    assert d.ipcache.lookup_by_ip("192.0.2.3/32") is not None
+    assert d.ipcache.lookup_by_ip("192.0.2.1/32") is None
+
+    # deleting the rule deregisters it: further DNS churn is inert
+    d.policy_delete(LabelArray.parse("rule=fqdn"))
+    assert d.ipcache.lookup_by_ip("192.0.2.2/32") is None
+    assert d._fqdn_rules == []
+    resolutions["db.example.com"] = (["192.0.2.9"], 60)
+    d.dns_poller.poll_once()
+    assert d.ipcache.lookup_by_ip("192.0.2.9/32") is None
+    d.shutdown()
+
+
+def test_generated_cidr_entries_not_echoed_via_kvstore():
+    """Policy-CIDR ipcache entries must stay node-local: the kvstore
+    echo would lock them at SOURCE_KVSTORE precedence forever."""
+    from cilium_tpu.policy.api import EgressRule, EndpointSelector, Rule
+    from cilium_tpu.labels import LabelArray
+    store = MemStore()
+    d = Daemon(config=DaemonConfig(),
+               kvstore_backend=InMemoryBackend(store), node_name="n1")
+    r = Rule(endpoint_selector=EndpointSelector.parse("app=a"),
+             egress=[EgressRule(to_cidr=["10.7.0.0/24"])],
+             labels=LabelArray.parse("rule=c"))
+    d.policy_add([r])
+    assert d.ipcache.lookup_by_ip("10.7.0.0/24") is not None
+    time.sleep(0.2)  # give any (buggy) echo a chance to land
+    d.policy_delete(LabelArray.parse("rule=c"))
+    assert _wait(lambda: d.ipcache.lookup_by_ip("10.7.0.0/24") is None)
+    d.shutdown()
+
+
+def test_rest_patch_labels_unknown_endpoint_404(agent):
+    d, server = agent
+    c = Client(server.base_url)
+    with pytest.raises(SystemExit, match="404"):
+        c.patch("/endpoint/999", {"labels": ["k8s:a=b"]})
