@@ -63,6 +63,13 @@ class Daemon:
                                   self.config.proxy_port_max)
         self.controllers = ControllerManager()
         self.datapath = Datapath(ct_slots=self.config.ct_slots)
+        # host fast path: C++ per-endpoint verdict caches (the eBPF
+        # hit-path analog); optional — the TPU path works without it
+        try:
+            from ..native.fastpath import HostVerdictPath
+            self.host_path = HostVerdictPath()
+        except (RuntimeError, OSError):
+            self.host_path = None
         self.dns_cache = DNSCache()
         self.dns_poller: Optional[DNSPoller] = None
         self.started_at = time.time()
@@ -287,6 +294,12 @@ class Daemon:
             always_allow_localhost=self.config.always_allow_localhost())
         ep.apply_regeneration(res)
         PROXY_REDIRECTS.set(len(self.proxy))
+        if self.host_path is not None:
+            self.host_path.sync_endpoint(ep.id, ep.realized)
+            # a delete racing this build could have already removed the
+            # cache; re-check so we never resurrect a deleted endpoint
+            if self.endpoints.lookup(ep.id) is None:
+                self.host_path.remove_endpoint(ep.id)
         self._reload_datapath_policy()
         if self.config.state_dir:
             try:
@@ -342,6 +355,8 @@ class Daemon:
             self.identity_allocator.release(ep.identity)
             IDENTITY_COUNT.set(len(self.identity_allocator))
         ep.set_state(EndpointState.DISCONNECTED, "delete")
+        if self.host_path is not None:
+            self.host_path.remove_endpoint(endpoint_id)
         if self.config.state_dir:
             try:
                 os.remove(os.path.join(self.config.state_dir,

@@ -434,3 +434,34 @@ def test_rest_patch_labels_unknown_endpoint_404(agent):
     c = Client(server.base_url)
     with pytest.raises(SystemExit, match="404"):
         c.patch("/endpoint/999", {"labels": ["k8s:a=b"]})
+
+
+def test_daemon_host_fastpath_agrees_with_device(agent):
+    """The daemon keeps the C++ host caches in sync with regeneration;
+    host verdicts equal device verdicts for the same endpoint."""
+    d, server = agent
+    if d.host_path is None:
+        pytest.skip("native runtime unavailable")
+    c = Client(server.base_url)
+    c.put("/endpoint/100", {"ipv4": "10.0.0.10",
+                            "labels": ["k8s:id=server"]})
+    c.put("/endpoint/200", {"ipv4": "10.0.0.20",
+                            "labels": ["k8s:id=client"]})
+    c.request("PUT", "/policy", json.loads(RULES_JSON))
+    assert d.wait_for_policy_revision()
+    ep = d.endpoints.lookup(100)
+    client_id = d.endpoints.lookup(200).security_identity
+    idents = np.array([client_id, client_id, 999], np.uint32)
+    dports = np.array([9999, 80, 22], np.int32)
+    host_v = d.host_path.classify(
+        100, idents, dports, np.full(3, 6, np.int32),
+        np.zeros(3, np.int32))
+    from cilium_tpu.compiler.policy_tables import oracle_verdict
+    for i in range(3):
+        assert host_v[i] == oracle_verdict(ep.realized, int(idents[i]),
+                                           int(dports[i]), 6, 0)
+    # endpoint delete clears its cache
+    c.delete("/endpoint/100")
+    assert d.host_path.classify(100, idents, dports,
+                                np.full(3, 6, np.int32),
+                                np.zeros(3, np.int32)) is None

@@ -1,0 +1,251 @@
+"""Native runtime tests: ring, verdict cache, struct alignment, and the
+two-tier (host cache -> TPU batch) fast path.
+
+Mirrors the reference's native-layer test posture: struct-ABI checks
+(pkg/alignchecker), map semantics (pkg/maps/policymap tests), and the
+hash-lockstep invariant between host and device tables.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from cilium_tpu.compiler.hashtab import hash_mix
+from cilium_tpu.compiler.policy_tables import pack_key
+from cilium_tpu.native import (PKT_HEADER_DTYPE, PacketRing, VerdictCache,
+                               check_struct_alignment, load)
+from cilium_tpu.policy.mapstate import INGRESS, PolicyKey
+
+
+def test_struct_alignment():
+    check_struct_alignment()
+
+
+def test_hash_lockstep_with_compiler():
+    lib = load()
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 2 ** 32, 200, dtype=np.uint32)
+    b = rng.integers(0, 2 ** 32, 200, dtype=np.uint32)
+    host = hash_mix(a, b)
+    native = np.array([lib.vc_hash_mix(int(x), int(y))
+                       for x, y in zip(a, b)], np.uint32)
+    np.testing.assert_array_equal(host, native)
+
+
+def test_ring_roundtrip_soa():
+    ring = PacketRing(capacity=1024)
+    recs = np.zeros(100, PKT_HEADER_DTYPE)
+    recs["endpoint"] = np.arange(100)
+    recs["saddr"] = np.arange(100) + 1000
+    recs["dport"] = 80
+    recs["proto"] = 6
+    recs["length"] = 512
+    assert ring.push(recs) == 100
+    assert len(ring) == 100
+    out, n = ring.pop_batch(64)
+    assert n == 64
+    np.testing.assert_array_equal(out["endpoint"], np.arange(64))
+    np.testing.assert_array_equal(out["saddr"], np.arange(64) + 1000)
+    assert (out["dport"] == 80).all() and (out["proto"] == 6).all()
+    out2, n2 = ring.pop_batch(64)
+    assert n2 == 36
+    np.testing.assert_array_equal(out2["endpoint"], np.arange(64, 100))
+    assert len(ring) == 0
+    ring.close()
+
+
+def test_ring_overflow_counts_drops():
+    ring = PacketRing(capacity=8)  # rounds to 8
+    recs = np.zeros(20, PKT_HEADER_DTYPE)
+    pushed = ring.push(recs)
+    assert pushed == 8
+    assert ring.dropped == 12
+    ring.close()
+
+
+def test_ring_spsc_threads():
+    ring = PacketRing(capacity=1 << 12)
+    total = 20_000
+    got = []
+
+    def producer():
+        sent = 0
+        while sent < total:
+            n = min(512, total - sent)
+            recs = np.zeros(n, PKT_HEADER_DTYPE)
+            recs["endpoint"] = np.arange(sent, sent + n)
+            pushed = ring.push(recs[:n], drop_on_full=False)
+            sent += pushed
+
+    def consumer():
+        seen = 0
+        while seen < total:
+            out, n = ring.pop_batch(1024)
+            if n:
+                got.append(out["endpoint"].copy())
+                seen += n
+
+    t1 = threading.Thread(target=producer)
+    t2 = threading.Thread(target=consumer)
+    t1.start(); t2.start()
+    t1.join(timeout=30); t2.join(timeout=30)
+    all_ids = np.concatenate(got)
+    assert len(all_ids) == total
+    np.testing.assert_array_equal(all_ids, np.arange(total))
+    assert ring.dropped == 0  # producer retried instead of dropping
+    ring.close()
+
+
+def test_verdict_cache_semantics():
+    vc = VerdictCache(slots=16)
+    ka, kb = pack_key(PolicyKey(identity=300, dest_port=80, nexthdr=6,
+                                direction=INGRESS))
+    assert vc.update(ka, kb, 0)
+    assert vc.update(ka + 1, kb, 15001)
+    assert len(vc) == 2
+    values, found = vc.lookup_batch(
+        np.array([ka, ka + 1, ka + 2], np.uint32),
+        np.array([kb, kb, kb], np.uint32))
+    assert found.tolist() == [True, True, False]
+    assert values[0] == 0 and values[1] == 15001
+    # update-in-place
+    assert vc.update(ka, kb, 7)
+    values, _ = vc.lookup_batch(np.array([ka], np.uint32),
+                                np.array([kb], np.uint32))
+    assert values[0] == 7
+    # key_b == 0 is reserved (empty marker)
+    assert not vc.update(1, 0, 1)
+    # delete + miss
+    assert vc.delete(ka, kb)
+    assert not vc.delete(ka, kb)
+    _, found = vc.lookup_batch(np.array([ka], np.uint32),
+                               np.array([kb], np.uint32))
+    assert not found[0]
+    assert len(vc) == 1
+    vc.flush()
+    assert len(vc) == 0
+    vc.close()
+
+
+def test_verdict_cache_grows_and_backward_shift_delete():
+    vc = VerdictCache(slots=8)
+    rng = np.random.default_rng(3)
+    keys = {}
+    while len(keys) < 500:
+        ka = int(rng.integers(0, 2 ** 32))
+        kb = int(rng.integers(1, 2 ** 32))
+        keys[(ka, kb)] = int(rng.integers(-1, 2 ** 15))
+    for (ka, kb), v in keys.items():
+        assert vc.update(ka, kb, v)
+    assert len(vc) == 500
+    assert vc.slots >= 1024  # grew past 0.5 load
+    karr = np.array([k[0] for k in keys], np.uint32)
+    kbrr = np.array([k[1] for k in keys], np.uint32)
+    values, found = vc.lookup_batch(karr, kbrr)
+    assert found.all()
+    np.testing.assert_array_equal(values,
+                                  np.array(list(keys.values()), np.int32))
+    # delete half; survivors must all still be findable (backward-shift
+    # correctness under long probe chains)
+    items = list(keys.items())
+    for (ka, kb), _ in items[:250]:
+        assert vc.delete(ka, kb)
+    survivors = items[250:]
+    karr = np.array([k[0] for k, _ in survivors], np.uint32)
+    kbrr = np.array([k[1] for k, _ in survivors], np.uint32)
+    values, found = vc.lookup_batch(karr, kbrr)
+    assert found.all()
+    np.testing.assert_array_equal(
+        values, np.array([v for _, v in survivors], np.int32))
+    dead = np.array([k[0] for k, _ in items[:250]], np.uint32)
+    deadb = np.array([k[1] for k, _ in items[:250]], np.uint32)
+    _, found = vc.lookup_batch(dead, deadb)
+    assert not found.any()
+    vc.close()
+
+
+def test_two_tier_fast_path_agrees_with_device():
+    """Host cache hits must equal device verdicts for cached flows."""
+    import jax.numpy as jnp
+    from cilium_tpu.compiler.policy_tables import (compile_endpoints,
+                                                   oracle_verdict)
+    from cilium_tpu.ops.hashtab_ops import batched_lookup
+    from cilium_tpu.policy.mapstate import (PolicyMapState,
+                                            PolicyMapStateEntry)
+
+    state = PolicyMapState()
+    rng = np.random.default_rng(9)
+    for _ in range(64):
+        state[PolicyKey(identity=int(rng.integers(256, 1000)),
+                        dest_port=int(rng.integers(1, 65536)), nexthdr=6,
+                        direction=INGRESS)] = \
+            PolicyMapStateEntry(proxy_port=int(rng.integers(0, 2) *
+                                               15001))
+    compiled = compile_endpoints([state], revision=1)
+
+    # the control plane syncs the same entries into the host cache
+    vc = VerdictCache()
+    for k, v in state.items():
+        ka, kb = pack_key(k)
+        vc.update(ka, kb, v.proxy_port)
+
+    keys = list(state.keys())
+    ka = np.array([pack_key(k)[0] for k in keys], np.uint32)
+    kb = np.array([pack_key(k)[1] for k in keys], np.uint32)
+    host_vals, host_found = vc.lookup_batch(ka, kb)
+    assert host_found.all()
+
+    dev_found, dev_vals, _ = batched_lookup(
+        jnp.asarray(compiled.key_id[0]), jnp.asarray(compiled.key_meta[0]),
+        jnp.asarray(compiled.value[0]),
+        jnp.asarray(ka.view(np.int32)), jnp.asarray(kb.view(np.int32)),
+        compiled.max_probe)
+    assert np.asarray(dev_found).all()
+    np.testing.assert_array_equal(host_vals, np.asarray(dev_vals))
+    for k, hv in zip(keys, host_vals):
+        assert oracle_verdict(state, k.identity, k.dest_port, k.nexthdr,
+                              k.direction) == hv
+    vc.close()
+
+
+def test_host_verdict_path_matches_oracle():
+    """The host 3-stage path must agree with the scalar oracle on a
+    randomized matrix (policygen-style)."""
+    from cilium_tpu.compiler.policy_tables import oracle_verdict
+    from cilium_tpu.native.fastpath import HostVerdictPath
+    from cilium_tpu.policy.mapstate import (EGRESS, PolicyMapState,
+                                            PolicyMapStateEntry)
+
+    rng = np.random.default_rng(11)
+    state = PolicyMapState()
+    idents = list(rng.integers(256, 300, 12))
+    ports = list(rng.integers(1, 1024, 12))
+    for i in range(12):
+        state[PolicyKey(identity=int(idents[i]), dest_port=int(ports[i]),
+                        nexthdr=6, direction=INGRESS)] = \
+            PolicyMapStateEntry(proxy_port=int(rng.integers(0, 2) * 12345))
+    # some L3-only and L4-wildcard entries to exercise stages 2/3
+    state[PolicyKey(identity=int(idents[0]),
+                    direction=INGRESS)] = PolicyMapStateEntry()
+    state[PolicyKey(identity=0, dest_port=443, nexthdr=6,
+                    direction=INGRESS)] = PolicyMapStateEntry(
+                        proxy_port=15001)
+
+    hv = HostVerdictPath()
+    hv.sync_endpoint(5, state)
+    n = 512
+    q_ident = rng.choice(np.array(idents + [9999, 0]), n).astype(np.uint32)
+    q_port = rng.choice(np.array(ports + [443, 7]), n).astype(np.int32)
+    q_proto = np.full(n, 6, np.int32)
+    q_dir = np.zeros(n, np.int32)
+    got = hv.classify(5, q_ident, q_port, q_proto, q_dir)
+    for i in range(n):
+        want = oracle_verdict(state, int(q_ident[i]), int(q_port[i]), 6,
+                              0)
+        assert got[i] == want, (i, q_ident[i], q_port[i], got[i], want)
+    # unknown endpoint -> None; removed endpoint -> None
+    assert hv.classify(6, q_ident, q_port, q_proto, q_dir) is None
+    hv.remove_endpoint(5)
+    assert hv.classify(5, q_ident, q_port, q_proto, q_dir) is None
+    hv.close()
