@@ -2,8 +2,16 @@
 """Benchmark: BASELINE config 1 — L3/L4 CIDR+port policy verdict throughput.
 
 Builds a 100-rule CIDR+port policy (BASELINE.json configs[0]), compiles it
-to device tensors, and streams synthetic packet batches through the fused
-datapath step (ipcache LPM -> 3-stage policy verdict -> counters).
+two ways, and streams synthetic packet batches through both verdict
+engines:
+
+  hash  — ipcache LPM + 3-stage hash-probe verdict (gather-based)
+  dense — broadcast-compare LPM + verdict (gather-free; the TPU-first
+          layout: [B, N] int32 compares on the VPU)
+
+Both engines implement bpf/lib/policy.h __policy_can_access semantics
+exactly (tests enforce parity with the scalar oracle). The headline
+number is the faster engine on this hardware.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -19,14 +27,11 @@ import numpy as np
 
 
 def build_config1(n_rules=100, n_endpoints=16, seed=7):
-    """100 CIDR+port allow rules -> (CompiledPolicy, CompiledLPM, oracle)."""
-    from cilium_tpu.compiler.lpm import compile_lpm
-    from cilium_tpu.compiler.policy_tables import compile_endpoints
+    """100 CIDR+port allow rules -> map states + prefix table."""
     from cilium_tpu.policy.mapstate import (EGRESS, PolicyKey,
                                             PolicyMapState,
                                             PolicyMapStateEntry)
     rng = np.random.default_rng(seed)
-    # Each rule: a /16 or /24 CIDR gets a distinct identity + a port allow.
     prefixes = {}
     states = [PolicyMapState() for _ in range(n_endpoints)]
     ident = 256
@@ -39,52 +44,99 @@ def build_config1(n_rules=100, n_endpoints=16, seed=7):
         for st in states:
             st[PolicyKey(identity=ident, dest_port=port, nexthdr=6,
                          direction=EGRESS)] = PolicyMapStateEntry()
-        # some rules also allow the identity at L3
         if i % 5 == 0:
             for st in states:
                 st[PolicyKey(identity=ident,
                              direction=EGRESS)] = PolicyMapStateEntry()
         ident += 1
-    compiled_policy = compile_endpoints(states, revision=1)
-    compiled_lpm = compile_lpm(prefixes)
-    return compiled_policy, compiled_lpm, states, prefixes
+    return states, prefixes
+
+
+def _time_engine(step, iters):
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        step()
+        lat.append(time.perf_counter() - t1)
+    return time.perf_counter() - t0, lat
 
 
 def main():
     import jax
     import jax.numpy as jnp
-    from cilium_tpu.datapath.pipeline import RawPacketBatch, make_step
-    from cilium_tpu.datapath.verdict import Counters
 
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
-    compiled_policy, compiled_lpm, states, prefixes = build_config1()
-    step, tables, counters = make_step(compiled_policy, compiled_lpm)
+    on_accel = jax.default_backend() != "cpu"
+    if not on_accel and len(sys.argv) <= 1:
+        batch = 1 << 17  # CPU smoke runs use a smaller default
+
+    states, prefixes = build_config1()
 
     rng = np.random.default_rng(1)
+    n_endpoints = len(states)
+    ep = rng.integers(0, n_endpoints, batch, dtype=np.int32)
+    src = rng.integers(0, 2 ** 32, batch, dtype=np.uint32).view(np.int32)
+    dport = rng.integers(1, 65536, batch, dtype=np.int32)
+    proto = np.full(batch, 6, np.int32)
+    direction = np.ones(batch, np.int32)
+    length = np.full(batch, 512, np.int32)
+
+    # ---- hash engine (LPM gather + 3-stage probe) ----------------------
+    from cilium_tpu.compiler.lpm import compile_lpm
+    from cilium_tpu.compiler.policy_tables import compile_endpoints
+    from cilium_tpu.datapath.pipeline import RawPacketBatch, make_step
+
+    compiled_policy = compile_endpoints(states, revision=1)
+    compiled_lpm = compile_lpm(prefixes)
+    h_step, h_tables, h_counters = make_step(compiled_policy, compiled_lpm)
     pkt = RawPacketBatch(
-        endpoint=jnp.asarray(rng.integers(0, compiled_policy.num_endpoints,
-                                          batch, dtype=np.int32)),
-        src_addr=jnp.asarray(rng.integers(0, 2 ** 32, batch,
-                                          dtype=np.uint32).view(np.int32)),
-        dport=jnp.asarray(rng.integers(1, 65536, batch, dtype=np.int32)),
-        proto=jnp.asarray(np.full(batch, 6, np.int32)),
-        direction=jnp.asarray(np.ones(batch, np.int32)),
-        length=jnp.asarray(np.full(batch, 512, np.int32)),
+        endpoint=jnp.asarray(ep), src_addr=jnp.asarray(src),
+        dport=jnp.asarray(dport), proto=jnp.asarray(proto),
+        direction=jnp.asarray(direction), length=jnp.asarray(length),
         is_fragment=jnp.asarray(np.zeros(batch, np.int32)))
 
-    # warmup / compile
-    verdict, identity, counters = step(tables, counters, pkt)
-    verdict.block_until_ready()
+    hstate = {"counters": h_counters}
 
-    iters = 30
-    lat = []
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        t1 = time.perf_counter()
-        verdict, identity, counters = step(tables, counters, pkt)
+    def hash_iter():
+        verdict, identity, hstate["counters"] = h_step(
+            h_tables, hstate["counters"], pkt)
         verdict.block_until_ready()
-        lat.append(time.perf_counter() - t1)
-    elapsed = time.perf_counter() - t0
+
+    hash_iter()  # compile
+
+    # ---- dense engine (gather-free broadcast compare) ------------------
+
+    from cilium_tpu.ops.dense_verdict import (compile_dense,
+                                              compile_dense_lpm,
+                                              dense_datapath_step)
+
+    d_tables = compile_dense(states)
+    d_lpm = compile_dense_lpm(prefixes)
+    n_entries = int(d_tables.ep.shape[0])
+    d_step = jax.jit(dense_datapath_step, donate_argnums=(2, 3))
+    dstate = {"cpk": jnp.zeros(n_entries, jnp.uint32),
+              "cby": jnp.zeros(n_entries, jnp.uint32)}
+    d_args = (jnp.asarray(ep), jnp.asarray(src), jnp.asarray(dport),
+              jnp.asarray(proto), jnp.asarray(direction),
+              jnp.asarray(length))
+
+    def dense_iter():
+        verdict, identity, dstate["cpk"], dstate["cby"] = d_step(
+            d_tables, d_lpm, dstate["cpk"], dstate["cby"], *d_args)
+        verdict.block_until_ready()
+
+    dense_iter()  # compile
+
+    # ---- probe both, run the winner longer -----------------------------
+    probe_iters = 3
+    h_probe, _ = _time_engine(hash_iter, probe_iters)
+    d_probe, _ = _time_engine(dense_iter, probe_iters)
+    winner = "dense" if d_probe < h_probe else "hash"
+    win_iter = dense_iter if winner == "dense" else hash_iter
+
+    iters = 30 if on_accel else 10
+    elapsed, lat = _time_engine(win_iter, iters)
     vps = iters * batch / elapsed
     p99_us = float(np.percentile(np.array(lat), 99) * 1e6)
 
@@ -94,10 +146,13 @@ def main():
         "value": round(vps),
         "unit": "verdicts/s",
         "vs_baseline": round(vps / target, 3),
-        "extra": {"batch": batch, "iters": iters,
+        "extra": {"batch": batch, "iters": iters, "engine": winner,
                   "p99_batch_latency_us": round(p99_us, 1),
+                  "hash_probe_vps": round(probe_iters * batch / h_probe),
+                  "dense_probe_vps": round(probe_iters * batch / d_probe),
                   "device": str(jax.devices()[0]),
                   "policy_entries": compiled_policy.entry_count(),
+                  "dense_entries": n_entries,
                   "lpm_entries": compiled_lpm.entry_count()},
     }))
 

@@ -21,7 +21,7 @@ import hashlib
 import os
 import subprocess
 import threading
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 
@@ -92,6 +92,8 @@ def load() -> ctypes.CDLL:
         lib.vc_destroy.argtypes = [vp]
         lib.vc_update.restype = ctypes.c_int
         lib.vc_update.argtypes = [vp, u32, u32, i32]
+        lib.vc_update_batch.restype = u64
+        lib.vc_update_batch.argtypes = [vp, p(u32), p(u32), p(i32), u64]
         lib.vc_delete.restype = ctypes.c_int
         lib.vc_delete.argtypes = [vp, u32, u32]
         lib.vc_lookup_batch.restype = u64
@@ -201,6 +203,17 @@ class VerdictCache:
     def update(self, key_a: int, key_b: int, value: int) -> bool:
         return bool(self._lib.vc_update(
             self._h, key_a & 0xFFFFFFFF, key_b & 0xFFFFFFFF, value))
+
+    def update_batch(self, key_a: np.ndarray, key_b: np.ndarray,
+                     values: np.ndarray) -> int:
+        """Bulk upsert; returns records applied (kb==0 rows skipped)."""
+        ka = np.ascontiguousarray(key_a, dtype=np.uint32)
+        kb = np.ascontiguousarray(key_b, dtype=np.uint32)
+        vals = np.ascontiguousarray(values, dtype=np.int32)
+        return self._lib.vc_update_batch(
+            self._h, ka.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            kb.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(ka))
 
     def delete(self, key_a: int, key_b: int) -> bool:
         return bool(self._lib.vc_delete(

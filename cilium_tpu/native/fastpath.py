@@ -12,11 +12,11 @@ the device tables (same packed keys, same hash).
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import numpy as np
 
-from ..compiler.policy_tables import pack_key
+from ..compiler.policy_tables import pack_key, pack_meta
 from ..policy.mapstate import PolicyMapState
 from . import VerdictCache, load
 
@@ -25,10 +25,10 @@ VERDICT_DROP = -1
 
 def _pack_meta_arrays(dport: np.ndarray, proto: np.ndarray,
                       direction: np.ndarray) -> np.ndarray:
-    """Vectorized key_b packing (policy_tables.pack_meta)."""
-    return (((dport.astype(np.uint32) & 0xFFFF) << 16) |
-            ((proto.astype(np.uint32) & 0xFF) << 8) |
-            ((direction.astype(np.uint32) & 1) << 1) | 1)
+    """Vectorized key_b packing — pack_meta's bit ops applied
+    elementwise, so the lockstep layout has one definition."""
+    return pack_meta(dport.astype(np.uint32), proto.astype(np.uint32),
+                     direction.astype(np.uint32))
 
 
 class HostVerdictPath:
@@ -49,9 +49,13 @@ class HostVerdictPath:
         old cache is released by refcount — an in-flight classify keeps
         it alive until it finishes."""
         cache = VerdictCache(self.slots)
-        for k, v in state.items():
-            ka, kb = pack_key(k)
-            cache.update(ka, kb, v.proxy_port)
+        if state:
+            packed = [pack_key(k) for k in state]
+            cache.update_batch(
+                np.array([p[0] for p in packed], np.uint32),
+                np.array([p[1] for p in packed], np.uint32),
+                np.array([v.proxy_port for v in state.values()],
+                         np.int32))
         with self._lock:
             self._caches[endpoint_id] = cache
 

@@ -250,6 +250,22 @@ int vc_update(void* h, uint32_t ka, uint32_t kb, int32_t value) {
     return c->insert_locked(ka, kb, value) ? 1 : 0;
 }
 
+// Bulk insert/update for control-plane sync: one lock acquisition and
+// one Python->C transition per endpoint instead of per entry. Returns
+// the number of records applied (reserved kb==0 rows are skipped).
+uint64_t vc_update_batch(void* h, const uint32_t* ka, const uint32_t* kb,
+                         const int32_t* value, uint64_t n) {
+    VerdictCache* c = static_cast<VerdictCache*>(h);
+    std::unique_lock<std::shared_mutex> lk(c->mu);
+    uint64_t applied = 0;
+    for (uint64_t i = 0; i < n; i++) {
+        if (kb[i] == 0) continue;
+        if ((c->entries + 1) * 2 > (uint64_t)c->mask + 1) c->grow_locked();
+        if (c->insert_locked(ka[i], kb[i], value[i])) applied++;
+    }
+    return applied;
+}
+
 int vc_delete(void* h, uint32_t ka, uint32_t kb) {
     VerdictCache* c = static_cast<VerdictCache*>(h);
     std::unique_lock<std::shared_mutex> lk(c->mu);
