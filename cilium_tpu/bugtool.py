@@ -1,0 +1,91 @@
+"""State archive collector for debugging.
+
+Reference: bugtool/ — ``cilium-bugtool`` snapshots agent state (status,
+policy, endpoints, maps, metrics, logs) into a tar archive an operator
+can attach to a bug report. Here the collectors read the in-process
+daemon; each lands as one JSON/text member in a tar.gz.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+import time
+from typing import Callable, Dict, Optional
+
+
+def _collectors(daemon) -> Dict[str, Callable[[], object]]:
+    return {
+        "status.json": daemon.status,
+        "policy.json": daemon.policy_get,
+        "endpoints.json": lambda: [ep.model()
+                                   for ep in daemon.endpoints.endpoints()],
+        "identities.json": daemon.identity_list,
+        "ipcache.json": lambda: [
+            {"prefix": p.prefix, "identity": p.identity,
+             "source": p.source, "host-ip": p.host_ip}
+            for p in daemon.ipcache.dump()],
+        "monitor-stats.json": daemon.monitor.stats,
+        "controllers.json": daemon.controllers.status_model,
+        "config.json": lambda: {"options": daemon.config.opts.dump(),
+                                "cluster": daemon.config.cluster_name},
+        "datapath.json": lambda: {
+            "revision": daemon.datapath.revision,
+            "conntrack-slots": daemon.datapath.ct.slots,
+            "services": len(daemon.datapath.lb),
+            "prefilter": daemon.datapath.prefilter.dump()[0]},
+        "metrics.txt": daemon.metrics_text,
+    }
+
+
+def _remote_collectors(client) -> Dict[str, Callable[[], object]]:
+    return {
+        "status.json": lambda: client.get("/healthz"),
+        "policy.json": lambda: client.get("/policy"),
+        "endpoints.json": lambda: client.get("/endpoint"),
+        "identities.json": lambda: client.get("/identity"),
+        "services.json": lambda: client.get("/service"),
+        "prefilter.json": lambda: client.get("/prefilter"),
+        "monitor-stats.json": lambda: client.get("/monitor/stats"),
+        "config.json": lambda: client.get("/config"),
+        "metrics.txt": lambda: client.get("/metrics", raw=True),
+    }
+
+
+def _write_archive(collectors: Dict[str, Callable[[], object]],
+                   out_path: Optional[str]) -> str:
+    ts = time.strftime("%Y%m%d-%H%M%S")
+    path = out_path or f"/tmp/cilium-tpu-bugtool-{ts}.tar.gz"
+    with tarfile.open(path, "w:gz") as tar:
+        for name, fn in collectors.items():
+            try:
+                data = fn()
+                if isinstance(data, str):
+                    blob = data.encode()
+                else:
+                    blob = json.dumps(data, indent=1, sort_keys=True,
+                                      default=str).encode()
+            # capture, don't abort — incl. SystemExit, which the REST
+            # Client raises on API errors
+            except (Exception, SystemExit) as exc:
+                blob = f"collector failed: {exc!r}".encode()
+                name += ".failed"
+            info = tarfile.TarInfo(name=f"cilium-tpu-bugtool-{ts}/{name}")
+            info.size = len(blob)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(blob))
+    return path
+
+
+def collect_remote(client, out_path: Optional[str] = None) -> str:
+    """Archive agent state over the REST API (the CLI path)."""
+    return _write_archive(_remote_collectors(client), out_path)
+
+
+def collect(daemon, out_path: Optional[str] = None) -> str:
+    """Write the archive from an in-process daemon; returns its path.
+
+    Collector failures are captured into the archive instead of
+    aborting it (bugtool keeps going on partial failures)."""
+    return _write_archive(_collectors(daemon), out_path)

@@ -260,6 +260,23 @@ def cmd_metrics(c: Client, args) -> int:
     return 0
 
 
+def cmd_bugtool(c: Client, args) -> int:
+    from .bugtool import collect_remote
+    path = collect_remote(c, args.output or None)
+    print(f"Archive written: {path}")
+    return 0
+
+
+def cmd_cni(c: Client, args) -> int:
+    import os
+    from . import cni
+    os.environ.setdefault("CILIUM_TPU_API", c.base_url)
+    os.environ["CNI_COMMAND"] = args.cni_cmd.upper()
+    if args.container_id:
+        os.environ["CNI_CONTAINERID"] = args.container_id
+    return cni.main()
+
+
 def cmd_agent(args) -> int:
     """Run the agent + API server in the foreground."""
     from .daemon import Daemon
@@ -360,6 +377,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("metrics", help="Prometheus metrics dump")
 
+    bt = sub.add_parser("bugtool", help="archive agent state for a bug report")
+    bt.add_argument("-o", "--output", default="")
+
+    cn = sub.add_parser("cni", help="CNI plugin entry (ADD/DEL/VERSION)")
+    cn.add_argument("cni_cmd", choices=["add", "del", "version"])
+    cn.add_argument("--container-id", default="")
+
     ag = sub.add_parser("agent", help="run the agent")
     ag.add_argument("--api-port", type=int, default=9234)
     ag.add_argument("--kvstore", default="none",
@@ -376,6 +400,7 @@ COMMANDS = {
     "identity": cmd_identity, "service": cmd_service,
     "prefilter": cmd_prefilter, "monitor": cmd_monitor,
     "config": cmd_config, "metrics": cmd_metrics,
+    "bugtool": cmd_bugtool, "cni": cmd_cni,
 }
 
 

@@ -220,6 +220,33 @@ class Daemon:
         self._fqdn_rules = [r for r in self._fqdn_rules
                             if id(r) not in doomed_ids]
 
+    def _resync_rule_prefixes_locked(self, rule: Rule) -> bool:
+        """Re-diff one rule's CIDR prefixes against its held refs and
+        retain/release the delta (newly referenced IPs need identities
+        + ipcache entries or their CIDR labels never match). Returns
+        True when anything changed. Lock held."""
+        old = self._rule_prefixes.get(id(rule), [])
+        new = self._rule_cidr_prefixes(rule)
+        if new == old:
+            return False
+        old_set, new_set = set(old), set(new)
+        self._retain_prefixes(sorted(new_set - old_set))
+        self._release_prefixes(sorted(old_set - new_set))
+        self._rule_prefixes[id(rule)] = new
+        return True
+
+    def resync_rule_prefixes(self, rules: Sequence[Rule]) -> int:
+        """Public entry for translators that rewrite rules in place
+        (k8s ToServices, FQDN): returns rules whose refs changed."""
+        n = 0
+        with self._lock:
+            live = {id(x) for x in self.repo.rules}
+            for r in rules:
+                if id(r) in self._rule_prefixes or id(r) in live:
+                    if self._resync_rule_prefixes_locked(r):
+                        n += 1
+        return n
+
     def _retain_prefixes(self, prefixes: Sequence[str]) -> None:
         """One ref per occurrence (lock held)."""
         for p in prefixes:
@@ -499,16 +526,8 @@ class Daemon:
             dirty = False
             with self._lock:
                 for r in self._fqdn_rules:
-                    old = self._rule_prefixes.get(id(r), [])
                     inject_to_cidr_set(r, self.dns_cache)
-                    new = self._rule_cidr_prefixes(r)
-                    if new != old:
-                        # newly resolved IPs need identities + ipcache
-                        # entries or their CIDR labels never match
-                        old_set, new_set = set(old), set(new)
-                        self._retain_prefixes(sorted(new_set - old_set))
-                        self._release_prefixes(sorted(old_set - new_set))
-                        self._rule_prefixes[id(r)] = new
+                    if self._resync_rule_prefixes_locked(r):
                         dirty = True
             if dirty:
                 self.trigger_policy_updates("fqdn-update")

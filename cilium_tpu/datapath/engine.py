@@ -9,6 +9,7 @@ reference (bpf_lxc.c egress/ingress) as one jitted program.
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -37,6 +38,11 @@ class Datapath:
     """
 
     def __init__(self, ct_slots: int = 1 << 16, ct_probe: int = 8):
+        # process/gc/_rebuild all touch donated CT buffers; without
+        # mutual exclusion the periodic GC controller can donate the
+        # state out from under an in-flight process() (deleted-array
+        # crash)
+        self._lock = threading.Lock()
         self.prefilter = PreFilter()
         self.lb = LoadBalancer()
         self.ct = ConntrackTable(slots=ct_slots, max_probe=ct_probe)
@@ -53,22 +59,27 @@ class Datapath:
                     revision: int,
                     ipcache_prefixes: Optional[Dict[str, int]] = None
                     ) -> None:
-        self.compiled_policy = compile_endpoints(map_states,
-                                                 revision=revision)
-        if ipcache_prefixes is not None or self.compiled_ipcache is None:
-            self.compiled_ipcache = compile_lpm(ipcache_prefixes or {})
-        self.revision = revision
-        self._rebuild()
+        with self._lock:
+            self.compiled_policy = compile_endpoints(map_states,
+                                                     revision=revision)
+            if ipcache_prefixes is not None or \
+                    self.compiled_ipcache is None:
+                self.compiled_ipcache = compile_lpm(ipcache_prefixes or {})
+            self.revision = revision
+            self._rebuild()
 
     def load_ipcache(self, prefixes: Dict[str, int]) -> None:
-        self.compiled_ipcache = compile_lpm(prefixes)
-        self._rebuild()
+        with self._lock:
+            self.compiled_ipcache = compile_lpm(prefixes)
+            self._rebuild()
 
     def reload_services(self) -> None:
-        self._rebuild()
+        with self._lock:
+            self._rebuild()
 
     def reload_prefilter(self) -> None:
-        self._rebuild()
+        with self._lock:
+            self._rebuild()
 
     def _rebuild(self) -> None:
         if self.compiled_policy is None:
@@ -103,18 +114,21 @@ class Datapath:
     def process(self, pkt: FullPacketBatch, now: Optional[int] = None):
         """Classify a batch. Returns (verdict, event, identity, nat) —
         nat carries the DNAT'd forward tuple and rev-NAT'd reply tuple."""
-        if self._step is None:
-            raise RuntimeError("no policy loaded")
-        (verdict, event, identity, nat,
-         self.ct.state, self.counters) = self._step(
-            self._tables, self.ct.state, self.counters, pkt,
-            jnp.int32(now if now is not None else int(time.time())))
-        return verdict, event, identity, nat
+        with self._lock:
+            if self._step is None:
+                raise RuntimeError("no policy loaded")
+            (verdict, event, identity, nat,
+             self.ct.state, self.counters) = self._step(
+                self._tables, self.ct.state, self.counters, pkt,
+                jnp.int32(now if now is not None else int(time.time())))
+            return verdict, event, identity, nat
 
     # -- maintenance ---------------------------------------------------------
 
     def gc(self, now: Optional[int] = None) -> int:
-        return self.ct.gc(now if now is not None else int(time.time()))
+        with self._lock:
+            return self.ct.gc(now if now is not None
+                              else int(time.time()))
 
 
 def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,

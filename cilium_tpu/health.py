@@ -1,0 +1,122 @@
+"""Active path probing across nodes.
+
+Reference: cilium-health + pkg/health — a prober walks the known node
+set, issues ICMP + HTTP probes per node (pkg/health/server/prober.go:
+139,229), and keeps per-path status with last-seen timestamps; results
+surface in ``cilium-health status`` and the agent status. Here the
+probe transport is pluggable (an in-process reachability function by
+default; a real deployment plugs sockets), the scheduling/state model
+is the same.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .utils.controller import ControllerManager, ControllerParams
+
+PROBE_ICMP = "icmp"
+PROBE_HTTP = "http"
+
+
+@dataclass
+class PathStatus:
+    """One node's probe results (healthModels.PathStatus analog)."""
+
+    node: str
+    ip: str
+    icmp_ok: Optional[bool] = None
+    http_ok: Optional[bool] = None
+    last_probed: float = 0.0
+    latency_s: Dict[str, float] = field(default_factory=dict)
+    failures: int = 0
+
+    @property
+    def healthy(self) -> bool:
+        return bool(self.icmp_ok) and self.http_ok is not False
+
+
+class HealthProber:
+    """Periodic prober over the node set.
+
+    ``nodes_fn`` returns [(node_name, ip)]; ``probe_fn(kind, ip)``
+    returns (ok, latency_seconds).
+    """
+
+    def __init__(self, nodes_fn: Callable[[], List],
+                 probe_fn: Optional[Callable[[str, str], tuple]] = None,
+                 interval: float = 10.0,
+                 controllers: Optional[ControllerManager] = None):
+        self.nodes_fn = nodes_fn
+        self.probe_fn = probe_fn or (lambda kind, ip: (True, 0.0))
+        self._lock = threading.Lock()
+        self._status: Dict[str, PathStatus] = {}
+        self._controllers = controllers or ControllerManager()
+        self._owns_controllers = controllers is None
+        self._controllers.update_controller(
+            "health-prober", ControllerParams(do_func=self.probe_once,
+                                              run_interval=interval))
+
+    def probe_once(self) -> None:
+        """One sweep over all known nodes (prober.go runProbe)."""
+        now = time.time()
+        seen = set()
+        for entry in self.nodes_fn():
+            name, ip = entry if isinstance(entry, tuple) else \
+                (entry.full_name, entry.get_node_ip())
+            if not ip:
+                continue
+            seen.add(name)
+            st = self._get(name, ip)
+            for kind in (PROBE_ICMP, PROBE_HTTP):
+                try:
+                    ok, lat = self.probe_fn(kind, ip)
+                except Exception:
+                    ok, lat = False, 0.0
+                if kind == PROBE_ICMP:
+                    st.icmp_ok = ok
+                else:
+                    st.http_ok = ok
+                st.latency_s[kind] = lat
+                if not ok:
+                    st.failures += 1
+            st.last_probed = now
+        with self._lock:
+            for name in list(self._status):
+                if name not in seen:
+                    del self._status[name]  # node left the cluster
+
+    def _get(self, name: str, ip: str) -> PathStatus:
+        with self._lock:
+            st = self._status.get(name)
+            if st is None or st.ip != ip:
+                st = PathStatus(node=name, ip=ip)
+                self._status[name] = st
+            return st
+
+    def status(self) -> Dict[str, Dict]:
+        """healthModels-shaped dump for REST/CLI."""
+        with self._lock:
+            return {
+                name: {
+                    "ip": st.ip,
+                    "icmp": st.icmp_ok,
+                    "http": st.http_ok,
+                    "healthy": st.healthy,
+                    "failures": st.failures,
+                    "latency-seconds": dict(st.latency_s),
+                    "last-probed": st.last_probed,
+                } for name, st in sorted(self._status.items())}
+
+    def unhealthy_nodes(self) -> List[str]:
+        with self._lock:
+            return [n for n, st in self._status.items() if not st.healthy]
+
+    def shutdown(self) -> None:
+        if self._owns_controllers:
+            self._controllers.remove_all()
+        else:
+            self._controllers.remove_controller("health-prober")

@@ -1,0 +1,56 @@
+"""ToServices -> ToCIDRSet translation from Endpoints objects.
+
+Reference: pkg/k8s/rule_translate.go — an egress rule naming a k8s
+service resolves to the service's backend IPs as generated CIDR rules;
+Endpoints add/delete events re-translate affected rules
+(Repository.TranslateRules, repository.go:674).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence
+
+from ..policy.api import CIDRRule, Rule
+
+
+def endpoints_to_ips(endpoints_obj: Dict) -> List[str]:
+    """k8s Endpoints object -> backend IPs (subsets[].addresses[].ip)."""
+    ips = []
+    for subset in endpoints_obj.get("subsets") or []:
+        for addr in subset.get("addresses") or []:
+            ip = addr.get("ip")
+            if ip:
+                ips.append(ip)
+    return ips
+
+
+def translate_to_services(rules: Sequence[Rule], service_name: str,
+                          namespace: str,
+                          backend_ips: Iterable[str]) -> int:
+    """Rewrite every egress ToServices reference to (service, ns) into
+    generated ToCIDRSet entries. Returns rules touched.
+
+    Reference: rule_translate.go RuleTranslator.Translate — existing
+    generated entries for the service are replaced (delete-then-add on
+    Endpoints change).
+    """
+    touched = 0
+    for rule in rules:
+        changed = False
+        for eg in rule.egress:
+            hit = any(
+                s.k8s_service is not None and
+                s.k8s_service.service_name == service_name and
+                (s.k8s_service.namespace or "default") == namespace
+                for s in eg.to_services)
+            if not hit:
+                continue
+            keep = [c for c in eg.to_cidr_set if not c.generated]
+            gen = [CIDRRule(cidr=f"{ip}/32" if ":" not in ip
+                            else f"{ip}/128", generated=True)
+                   for ip in backend_ips]
+            eg.to_cidr_set = keep + gen
+            changed = True
+        if changed:
+            touched += 1
+    return touched

@@ -1,0 +1,121 @@
+"""k8s event watcher driving the daemon.
+
+Reference: daemon/k8s_watcher.go — informers for CNPs, k8s
+NetworkPolicies, Services, Endpoints, Pods and Namespaces feed the
+policy repository and the service/endpoint state. Here the watcher is a
+sink for an event stream (dicts shaped like k8s watch events); any
+source — a test, a file replay, or a real apiserver client — pushes
+into it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..labels import LabelArray, Label, SOURCE_K8S
+from .policy import (POLICY_LABEL_NAME, POLICY_LABEL_NAMESPACE,
+                     parse_cnp, parse_network_policy)
+from .translate import endpoints_to_ips, translate_to_services
+
+
+def _policy_key_labels(name: str, namespace: str) -> LabelArray:
+    return LabelArray([
+        Label(key=POLICY_LABEL_NAME, value=name, source=SOURCE_K8S),
+        Label(key=POLICY_LABEL_NAMESPACE, value=namespace,
+              source=SOURCE_K8S)])
+
+
+class K8sWatcher:
+    """Apply k8s object events to a Daemon."""
+
+    def __init__(self, daemon):
+        self.daemon = daemon
+        self._lock = threading.Lock()
+        # (namespace, service) -> backend ips, for ToServices
+        self._endpoints: Dict[tuple, List[str]] = {}
+        self.events_processed = 0
+
+    # ------------------------------------------------------------ policy
+
+    def on_cnp(self, action: str, obj: Dict) -> None:
+        """action: added | modified | deleted
+        (k8s_watcher.go addCiliumNetworkPolicyV2 et al.)."""
+        meta = obj.get("metadata") or {}
+        name = meta.get("name", "")
+        namespace = meta.get("namespace", "default")
+        key = _policy_key_labels(name, namespace)
+        if action in ("added", "modified"):
+            rules = parse_cnp(obj)
+            self._retranslate(rules)
+            self.daemon.policy_add(rules, replace=True)
+        elif action == "deleted":
+            self.daemon.policy_delete(key)
+        self._count()
+
+    def on_network_policy(self, action: str, obj: Dict) -> None:
+        meta = obj.get("metadata") or {}
+        key = _policy_key_labels(meta.get("name", ""),
+                                 meta.get("namespace", "default"))
+        if action in ("added", "modified"):
+            rules = parse_network_policy(obj)
+            self.daemon.policy_add(rules, replace=True)
+        elif action == "deleted":
+            self.daemon.policy_delete(key)
+        self._count()
+
+    # --------------------------------------------------------- services
+
+    def on_service(self, action: str, obj: Dict) -> None:
+        """ClusterIP services program the LB (k8s_watcher.go
+        addK8sServiceV1)."""
+        meta = obj.get("metadata") or {}
+        spec = obj.get("spec") or {}
+        vip = spec.get("clusterIP")
+        if not vip or vip == "None":
+            return
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        if action == "deleted":
+            for p in spec.get("ports") or []:
+                self.daemon.service_delete(vip, int(p.get("port", 0)))
+        else:
+            backends = self._endpoints.get(key, [])
+            for p in spec.get("ports") or []:
+                port = int(p.get("port", 0))
+                try:
+                    target = int(p.get("targetPort") or port)
+                except (TypeError, ValueError):
+                    # named targetPort: resolving it needs pod specs;
+                    # fall back to the service port (reference resolves
+                    # through Endpoints ports)
+                    target = port
+                self.daemon.service_upsert(
+                    vip, port, [(ip, target) for ip in backends])
+        self._count()
+
+    def on_endpoints(self, action: str, obj: Dict) -> None:
+        """Endpoints drive both LB backends and ToServices translation
+        (k8s_watcher.go addK8sEndpointV1 + rule_translate)."""
+        meta = obj.get("metadata") or {}
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        ips = [] if action == "deleted" else endpoints_to_ips(obj)
+        with self._lock:
+            self._endpoints[key] = ips
+        rules = self.daemon.repo.rules
+        touched = translate_to_services(rules, key[1], key[0], ips)
+        if touched:
+            # the new backend /32s need CIDR identities + ipcache
+            # entries before the regenerated policy can match them
+            self.daemon.resync_rule_prefixes(rules)
+            self.daemon.trigger_policy_updates("k8s-endpoints")
+        self._count()
+
+    def _retranslate(self, rules) -> None:
+        with self._lock:
+            snapshot = dict(self._endpoints)
+        for (ns, svc), ips in snapshot.items():
+            translate_to_services(rules, svc, ns, ips)
+
+    def _count(self) -> None:
+        with self._lock:
+            self.events_processed += 1

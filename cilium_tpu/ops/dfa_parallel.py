@@ -1,0 +1,142 @@
+"""Sequence-parallel DFA evaluation: composition scan over bytes.
+
+``dfa_scan`` (ops/dfa_ops) walks the payload serially — O(L) dependent
+steps. This module is the long-sequence treatment (the ring-attention /
+context-parallel analog for this domain, SURVEY.md §2.8): a DFA step on
+byte ``c`` is a function f_c: state -> state, i.e. a vector
+``table[:, c]`` of shape [S]; matching a payload is the composition
+f_{c_L} ∘ … ∘ f_{c_1}. Function composition is associative, so:
+
+- ``dfa_parallel_scan``: ``jax.lax.associative_scan`` over the byte
+  axis — O(log L) depth, every position's composition computed in
+  parallel on-device (the scan work is [L, S] gathers: VPU-friendly).
+- ``dfa_scan_sharded``: ``shard_map`` over a mesh axis with the
+  sequence dimension sharded — each device composes its local chunk,
+  then a log-width ``lax.ppermute`` exclusive-prefix exchange composes
+  chunk boundaries over ICI, exactly the blockwise/ring pattern used
+  for ring attention, with transition functions instead of KV blocks.
+
+Padding bytes (-1) compose as the identity function, so ragged payloads
+need no special casing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def transition_functions(table: jnp.ndarray,
+                         data: jnp.ndarray) -> jnp.ndarray:
+    """Bytes -> per-position transition vectors.
+
+    table: [S, 256]; data: [..., L] int32 bytes (-1 == padding).
+    Returns [..., L, S] where out[..., i, s] = next state from s on
+    byte i (identity for padding)."""
+    s = table.shape[0]
+    ident = jnp.arange(s, dtype=table.dtype)
+    safe = jnp.where(data >= 0, data, 0)
+    f = table.T[safe]                      # [..., L, S]
+    return jnp.where((data >= 0)[..., None], f, ident)
+
+
+def compose(g: jnp.ndarray, f: jnp.ndarray) -> jnp.ndarray:
+    """(g ∘ f)[..., s] = g[..., f[..., s]] — 'apply f first, then g'.
+
+    Both [..., S]; batched gather along the last axis."""
+    return jnp.take_along_axis(g, f, axis=-1)
+
+
+def dfa_parallel_scan(table: jnp.ndarray, states: jnp.ndarray,
+                      data: jnp.ndarray) -> jnp.ndarray:
+    """Sequence-parallel equivalent of dfa_ops.dfa_scan.
+
+    table: [S, 256]; states: [B, R]; data: [B, L].
+    Returns final states [B, R]."""
+    f = transition_functions(table, data)          # [B, L, S]
+    # scan composes left-to-right: out[i] = f_i ∘ … ∘ f_0
+    total = lax.associative_scan(
+        lambda a, b: compose(b, a), f, axis=1)[:, -1]   # [B, S]
+    return jnp.take_along_axis(total, states, axis=-1)
+
+
+def dfa_match_parallel(table: jnp.ndarray, accept: jnp.ndarray,
+                       starts: jnp.ndarray,
+                       data: jnp.ndarray) -> jnp.ndarray:
+    """Anchored match of every regex against every row (parallel scan).
+
+    Same contract as dfa_ops.dfa_match."""
+    b = data.shape[0]
+    states = jnp.broadcast_to(starts[None, :],
+                              (b, starts.shape[0])).astype(jnp.int32)
+    final = dfa_parallel_scan(table, states, data)
+    ok = accept[final]
+    overlong = jnp.any(data == -2, axis=1)
+    return ok & ~overlong[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Multi-chip: sequence axis sharded over the mesh
+# ---------------------------------------------------------------------------
+
+def dfa_scan_sharded(table: jnp.ndarray, states: jnp.ndarray,
+                     data: jnp.ndarray, mesh: Mesh,
+                     seq_axis: str) -> jnp.ndarray:
+    """Final DFA states with the SEQUENCE dimension sharded over
+    ``seq_axis`` — context parallelism for payloads too long for one
+    chip.
+
+    Each device composes its local [L/N] chunk into one transition
+    vector (log-depth associative scan), then an exclusive-prefix
+    composition across devices runs as log2(N) ``lax.ppermute`` hops
+    over ICI; finally every device applies (prefix ∘ local) and the
+    last shard holds the answer, which is returned replicated.
+
+    table/states replicated; data [B, L] with L divisible by the axis
+    size. Returns final states [B, R] (replicated)."""
+    n = mesh.shape[seq_axis]
+    s = table.shape[0]
+
+    def local(table_l, states_l, data_l):
+        f = transition_functions(table_l, data_l)   # [B, L/N, S]
+        chunk = lax.associative_scan(
+            lambda a, b: compose(b, a), f, axis=1)[:, -1]  # [B, S]
+
+        # Hillis-Steele inclusive prefix composition across devices:
+        # after round hop, acc_i = f_i ∘ … ∘ f_{max(0, i-2*hop+1)}; at
+        # the end acc_i = f_i ∘ … ∘ f_0 (log2(N) ppermute hops on ICI)
+        idx = lax.axis_index(seq_axis)
+        ident = jnp.broadcast_to(jnp.arange(s, dtype=chunk.dtype),
+                                 chunk.shape)
+        acc = chunk
+        hop = 1
+        while hop < n:
+            shifted = lax.ppermute(
+                acc, seq_axis,
+                [(i, i + hop) for i in range(n - hop)])
+            # devices with nothing to their left compose with identity
+            shifted = jnp.where(idx >= hop, shifted, ident)
+            acc = compose(acc, shifted)  # earlier chunks apply first
+            hop <<= 1
+
+        # the last shard's inclusive prefix is the whole sequence;
+        # replicate it via a masked psum
+        is_last = (idx == n - 1).astype(acc.dtype)
+        total = lax.psum(acc * is_last, seq_axis)
+        return jnp.take_along_axis(total, states_l, axis=-1)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(), P(None, seq_axis)),
+        out_specs=P(),
+    )(table, states, data)

@@ -752,7 +752,11 @@ class EgressRule:
         members = {
             "ToEndpoints": len(self.to_endpoints),
             "ToCIDR": len(self.to_cidr),
-            "ToCIDRSet": len(self.to_cidr_set),
+            # generated entries are injected by ToServices/ToFQDNs
+            # translation and legitimately coexist with their source
+            # member (rule_translate.go / fqdn inject paths)
+            "ToCIDRSet": len([c for c in self.to_cidr_set
+                              if not c.generated]),
             "ToEntities": len(self.to_entities),
             "ToServices": len(self.to_services),
             "ToFQDNs": len(self.to_fqdns),

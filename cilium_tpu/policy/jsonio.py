@@ -105,9 +105,13 @@ def _cidr_rule_to_dict(c: CIDRRule) -> Dict:
 
 
 def _cidr_rule_from_dict(d: Dict) -> CIDRRule:
+    # The ``generated`` flag marks entries the agent derives internally
+    # (ToServices/FQDN translation); accepting it from user input would
+    # bypass the L3 member-exclusivity check, so parsing always clears
+    # it — derived entries are recreated by the translators on import.
     return CIDRRule(cidr=d["cidr"],
                     except_cidrs=tuple(d.get("except", ())),
-                    generated=bool(d.get("generated", False)))
+                    generated=False)
 
 
 # ------------------------------------------------------------------- rules

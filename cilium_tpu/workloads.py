@@ -1,0 +1,85 @@
+"""Container-runtime workload watcher.
+
+Reference: pkg/workloads — docker/containerd/CRI-O event watchers keep
+endpoint labels in sync with container state (start events create or
+relabel endpoints, die events clean them up). The runtime client is
+pluggable here: any source pushes ``start``/``stop`` events with
+container metadata; the watcher drives the daemon's endpoint lifecycle
+and allocates IPs through IPAM.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .ipam import HostScopeIPAM, IPAMError
+
+
+class WorkloadWatcher:
+    """Container events -> endpoint lifecycle."""
+
+    def __init__(self, daemon, ipam: Optional[HostScopeIPAM] = None,
+                 label_prefix: str = "container"):
+        self.daemon = daemon
+        self.ipam = ipam
+        self.label_prefix = label_prefix
+        self._lock = threading.Lock()
+        self._by_container: Dict[str, int] = {}
+        self._next_ep_id = 1000
+        self.events = 0
+
+    def _labels_of(self, container: Dict) -> List[str]:
+        return [f"{self.label_prefix}:{k}={v}"
+                for k, v in sorted((container.get("labels") or {}).items())]
+
+    def on_start(self, container: Dict) -> int:
+        """Container started (workloads processCreateWorkload): create
+        or relabel its endpoint. ``container``: {id, name, labels}."""
+        cid = container["id"]
+        with self._lock:
+            self.events += 1
+            ep_id = self._by_container.get(cid)
+            if ep_id is None:
+                ep_id = self._next_ep_id
+                self._next_ep_id += 1
+                self._by_container[cid] = ep_id
+                create = True
+            else:
+                create = False
+        labels = self._labels_of(container)
+        if create:
+            ipv4 = ""
+            if self.ipam is not None:
+                try:
+                    ipv4 = self.ipam.allocate_next(owner=cid)
+                except IPAMError:
+                    ipv4 = ""
+            self.daemon.endpoint_create(
+                ep_id, ipv4=ipv4, container_name=container.get("name", cid),
+                labels=labels)
+        else:
+            self.daemon.endpoint_update_labels(ep_id, labels)
+        return ep_id
+
+    def on_stop(self, container_id: str) -> bool:
+        """Container died: tear the endpoint down."""
+        with self._lock:
+            self.events += 1
+            ep_id = self._by_container.pop(container_id, None)
+        if ep_id is None:
+            return False
+        ep = self.daemon.endpoints.lookup(ep_id)
+        ip = ep.ipv4 if ep else ""
+        ok = self.daemon.endpoint_delete(ep_id)
+        if ok and ip and self.ipam is not None:
+            self.ipam.release(ip)
+        return ok
+
+    def endpoint_of(self, container_id: str) -> Optional[int]:
+        with self._lock:
+            return self._by_container.get(container_id)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._by_container)

@@ -1,0 +1,351 @@
+"""Auxiliary subsystems: health prober, xDS cache, IPAM, workloads,
+bugtool, CNI.
+"""
+
+import json
+import os
+import tarfile
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.health import HealthProber
+from cilium_tpu.ipam import HostScopeIPAM, IPAMError
+from cilium_tpu.utils.option import DaemonConfig
+from cilium_tpu.workloads import WorkloadWatcher
+from cilium_tpu.xds import (TYPE_NETWORK_POLICY, Cache,
+                            host_mapping_resources)
+
+
+# -------------------------------------------------------------------- health
+
+def test_health_prober_sweep_and_node_removal():
+    nodes = [("default/n1", "192.168.0.1"), ("default/n2", "192.168.0.2")]
+    down = {"192.168.0.2"}
+
+    def probe(kind, ip):
+        return (ip not in down, 0.001)
+
+    p = HealthProber(lambda: list(nodes), probe_fn=probe, interval=3600)
+    p.probe_once()
+    st = p.status()
+    assert st["default/n1"]["healthy"]
+    assert not st["default/n2"]["healthy"]
+    assert p.unhealthy_nodes() == ["default/n2"]
+    # node leaves the cluster -> status entry reaped
+    nodes.pop(1)
+    p.probe_once()
+    assert "default/n2" not in p.status()
+    # probe exceptions count as failures, don't kill the sweep
+    def bad(kind, ip):
+        raise OSError("no route")
+    p.probe_fn = bad
+    p.probe_once()
+    assert not p.status()["default/n1"]["healthy"]
+    p.shutdown()
+
+
+# ----------------------------------------------------------------------- xds
+
+def test_xds_versioning_watch_and_ack_barrier():
+    cache = Cache()
+    w1 = cache.watch(TYPE_NETWORK_POLICY, "proxy-1")
+    w2 = cache.watch(TYPE_NETWORK_POLICY, "proxy-2")
+
+    v = cache.set_resources(TYPE_NETWORK_POLICY, {"100": {"policy": 7}})
+    assert v == 1
+    got = w1.next(timeout=2)
+    assert got.version == 1 and got.resources["100"]["policy"] == 7
+
+    comp = cache.wait_for_acks(TYPE_NETWORK_POLICY, 1)
+    assert not comp.completed
+    w1.ack(1)
+    assert not comp.completed   # proxy-2 hasn't acked
+    w2.ack(1)
+    assert comp.completed       # barrier released
+
+    # upsert bumps version; watcher sees only the newest
+    cache.upsert(TYPE_NETWORK_POLICY, "200", {"policy": 8})
+    cache.delete(TYPE_NETWORK_POLICY, "100")
+    got = w1.next(timeout=2)
+    assert got.version == 3
+    assert set(got.resources) == {"200"}
+    # ack of a later version satisfies barriers on earlier ones
+    comp2 = cache.wait_for_acks(TYPE_NETWORK_POLICY, 2)
+    w1.ack(3)
+    w2.ack(3)
+    assert comp2.completed
+    # nacks are recorded
+    w1.nack(3, "bad resource")
+    assert cache.nacks[0][1] == "proxy-1"
+    # no watchers for a type => barrier completes immediately
+    assert cache.wait_for_acks("type/none", 1).completed
+
+
+def test_xds_watch_blocks_until_update():
+    cache = Cache()
+    w = cache.watch(TYPE_NETWORK_POLICY, "p")
+    assert w.next(timeout=0.05) is None
+    result = {}
+
+    def consume():
+        result["vr"] = w.next(timeout=5)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    cache.set_resources(TYPE_NETWORK_POLICY, {"a": 1})
+    t.join(timeout=5)
+    assert result["vr"].version == 1
+
+
+def test_host_mapping_resources_shape():
+    res = host_mapping_resources({"10.0.0.1": 300, "10.0.0.2": 300,
+                                  "10.0.0.3": 400})
+    assert res["300"]["host_addresses"] == ["10.0.0.1", "10.0.0.2"]
+    assert res["400"]["policy"] == 400
+
+
+# ---------------------------------------------------------------------- ipam
+
+def test_ipam_allocate_release_cycle():
+    ipam = HostScopeIPAM("10.5.0.0/29", reserve_first=2)  # 8 addrs
+    # usable: .2 .3 .4 .5 .6 (network .0, reserved .1, broadcast .7)
+    ips = [ipam.allocate_next(owner=f"c{i}") for i in range(5)]
+    assert ips[0] == "10.5.0.2"
+    with pytest.raises(IPAMError):
+        ipam.allocate_next()
+    assert ipam.release("10.5.0.4")
+    assert ipam.allocate_next() == "10.5.0.4"
+    assert len(ipam) == 5
+    # double release is a no-op
+    assert ipam.release("10.5.0.4")
+    assert not ipam.release("10.5.0.4")
+    assert len(ipam) == 4
+
+
+def test_ipam_allocate_specific_for_restore():
+    ipam = HostScopeIPAM("10.5.0.0/24")
+    assert ipam.allocate_ip("10.5.0.77", owner="restored") == "10.5.0.77"
+    with pytest.raises(IPAMError):
+        ipam.allocate_ip("10.5.0.77")
+    with pytest.raises(IPAMError):
+        ipam.allocate_ip("10.9.0.1")  # outside the pod CIDR
+    # allocate_next skips the restored address when it reaches it
+    seen = {ipam.allocate_next() for _ in range(100)}
+    assert "10.5.0.77" not in seen
+
+
+# ------------------------------------------------------------------ workloads
+
+def test_workload_watcher_lifecycle():
+    d = Daemon(config=DaemonConfig())
+    ipam = HostScopeIPAM("10.8.0.0/24")
+    w = WorkloadWatcher(d, ipam=ipam)
+    try:
+        ep_id = w.on_start({"id": "abc123", "name": "web-1",
+                            "labels": {"app": "web"}})
+        assert d.wait_for_quiesce(10)
+        ep = d.endpoints.lookup(ep_id)
+        assert ep is not None
+        assert ep.container_name == "web-1"
+        assert ep.ipv4.startswith("10.8.0.")
+        assert d.ipcache.lookup_by_ip(ep.ipv4) == ep.security_identity
+        first_identity = ep.security_identity
+
+        # label change on restart -> same endpoint, new identity
+        w.on_start({"id": "abc123", "name": "web-1",
+                    "labels": {"app": "web", "tier": "frontend"}})
+        assert d.wait_for_quiesce(10)
+        assert w.endpoint_of("abc123") == ep_id
+        assert d.endpoints.lookup(ep_id).security_identity != \
+            first_identity
+
+        ip = ep.ipv4
+        assert w.on_stop("abc123")
+        assert d.endpoints.lookup(ep_id) is None
+        assert len(ipam) == 0  # IP returned to the pool
+        assert d.ipcache.lookup_by_ip(ip) is None
+        assert not w.on_stop("abc123")  # idempotent
+    finally:
+        d.shutdown()
+
+
+# -------------------------------------------------------------------- bugtool
+
+def test_bugtool_archives_daemon_state(tmp_path):
+    from cilium_tpu.bugtool import collect
+    d = Daemon(config=DaemonConfig())
+    try:
+        d.endpoint_create(1, ipv4="10.0.0.1", labels=["k8s:a=b"])
+        assert d.wait_for_quiesce(10)
+        out = str(tmp_path / "bug.tar.gz")
+        path = collect(d, out)
+        assert path == out
+        with tarfile.open(path) as tar:
+            names = [os.path.basename(m.name) for m in tar.getmembers()]
+            assert "status.json" in names
+            assert "endpoints.json" in names
+            assert "metrics.txt" in names
+            member = [m for m in tar.getmembers()
+                      if m.name.endswith("endpoints.json")][0]
+            eps = json.load(tar.extractfile(member))
+            assert eps[0]["id"] == 1
+    finally:
+        d.shutdown()
+
+
+# ------------------------------------------------------------------------ cni
+
+def test_cni_add_del_via_rest(tmp_path):
+    from cilium_tpu.cli import Client
+    from cilium_tpu.cni import cni_add, cni_del, _endpoint_id_for
+    from cilium_tpu.daemon.rest import APIServer
+    d = Daemon(config=DaemonConfig())
+    server = APIServer(d).start()
+    try:
+        c = Client(server.base_url)
+        result = cni_add(c, "container-xyz", netns="/proc/1/ns/net",
+                         config={"ip": "10.0.0.42",
+                                 "labels": {"app": "db"}})
+        assert result["cniVersion"] == "0.3.1"
+        assert result["ips"][0]["address"] == "10.0.0.42/32"
+        ep_id = _endpoint_id_for("container-xyz")
+        ep = d.endpoints.lookup(ep_id)
+        assert ep is not None and ep.ipv4 == "10.0.0.42"
+        assert any("app=db" in str(l) for l in ep.labels.to_array())
+        assert cni_del(c, "container-xyz")
+        assert d.endpoints.lookup(ep_id) is None
+        assert not cni_del(c, "container-xyz")  # idempotent
+    finally:
+        server.shutdown()
+        d.shutdown()
+
+
+# --------------------------------------------- review-regression coverage
+
+def test_np_match_expressions_preserved():
+    from cilium_tpu.k8s import parse_network_policy
+    from cilium_tpu.labels import LabelArray
+    np_obj = {
+        "metadata": {"name": "expr-np", "namespace": "prod"},
+        "spec": {
+            "podSelector": {},
+            "ingress": [{"from": [{"podSelector": {"matchExpressions": [
+                {"key": "role", "operator": "In",
+                 "values": ["frontend", "edge"]}]}}]}],
+        },
+    }
+    rules = parse_network_policy(np_obj)
+    sel = rules[0].ingress[0].from_endpoints[0]
+    fe = LabelArray.parse_select("k8s:role=frontend",
+                                 "k8s:io.kubernetes.pod.namespace=prod")
+    other = LabelArray.parse_select("k8s:role=backend",
+                                    "k8s:io.kubernetes.pod.namespace=prod")
+    assert sel.matches(fe)
+    assert not sel.matches(other)  # expressions must not be dropped
+
+
+def test_watcher_toservices_allocates_cidr_identities():
+    d = Daemon(config=DaemonConfig())
+    from cilium_tpu.k8s import K8sWatcher
+    w = K8sWatcher(d)
+    try:
+        w.on_cnp("added", {
+            "metadata": {"name": "svc-pol", "namespace": "prod"},
+            "spec": {"endpointSelector": {"matchLabels": {"app": "web"}},
+                     "egress": [{"toServices": [{"k8sService": {
+                         "serviceName": "db", "namespace": "prod"}}]}]}})
+        w.on_endpoints("added", {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "subsets": [{"addresses": [{"ip": "10.0.0.50"}]}]})
+        # the backend /32 received a CIDR identity + ipcache entry
+        assert d.ipcache.lookup_by_ip("10.0.0.50/32") is not None
+        # backend change releases the old prefix and maps the new one
+        w.on_endpoints("added", {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "subsets": [{"addresses": [{"ip": "10.0.0.51"}]}]})
+        assert d.ipcache.lookup_by_ip("10.0.0.51/32") is not None
+        assert d.ipcache.lookup_by_ip("10.0.0.50/32") is None
+    finally:
+        d.shutdown()
+
+
+def test_watcher_named_target_port_survives():
+    d = Daemon(config=DaemonConfig())
+    from cilium_tpu.k8s import K8sWatcher
+    w = K8sWatcher(d)
+    try:
+        w.on_endpoints("added", {
+            "metadata": {"name": "web", "namespace": "default"},
+            "subsets": [{"addresses": [{"ip": "10.0.0.3"}]}]})
+        w.on_service("added", {
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"clusterIP": "10.96.0.2",
+                     "ports": [{"port": 80, "targetPort": "http"}]}})
+        svc = d.datapath.lb.services()[0]
+        assert svc.backends[0].port == 80  # fell back to service port
+    finally:
+        d.shutdown()
+
+
+def test_json_import_cannot_smuggle_generated_flag():
+    from cilium_tpu.policy.api import PolicyError
+    from cilium_tpu.policy.jsonio import rules_from_json
+    bad = json.dumps([{
+        "endpointSelector": {"matchLabels": {"a": "b"}},
+        "egress": [{"toEndpoints": [{"matchLabels": {"c": "d"}}],
+                    "toCIDRSet": [{"cidr": "10.0.0.0/8",
+                                   "generated": True}]}]}])
+    rules = rules_from_json(bad)
+    with pytest.raises(PolicyError):
+        rules[0].sanitize()  # exclusivity check must still fire
+
+
+def test_xds_no_deadlock_upsert_vs_next():
+    """Concurrent upserts and blocking next() must not deadlock."""
+    cache = Cache()
+    w = cache.watch(TYPE_NETWORK_POLICY, "p")
+    stop = threading.Event()
+    errors = []
+
+    def producer():
+        for i in range(200):
+            cache.upsert(TYPE_NETWORK_POLICY, f"r{i % 5}", {"v": i})
+        stop.set()
+
+    def consumer():
+        try:
+            while not stop.is_set():
+                vr = w.next(timeout=0.01)
+                if vr:
+                    w.ack(vr.version)
+        except Exception as e:
+            errors.append(e)
+
+    t1 = threading.Thread(target=producer)
+    t2 = threading.Thread(target=consumer)
+    t1.start(); t2.start()
+    t1.join(timeout=20); t2.join(timeout=20)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert not errors
+    assert cache.get(TYPE_NETWORK_POLICY).version == 200
+
+
+def test_cni_add_idempotent():
+    from cilium_tpu.cli import Client
+    from cilium_tpu.cni import cni_add, _endpoint_id_for
+    from cilium_tpu.daemon.rest import APIServer
+    d = Daemon(config=DaemonConfig())
+    server = APIServer(d).start()
+    try:
+        c = Client(server.base_url)
+        r1 = cni_add(c, "retry-me", config={"ip": "10.0.0.9"})
+        r2 = cni_add(c, "retry-me", config={"ip": "10.0.0.9"})  # retried
+        assert r1 == r2
+        assert d.endpoints.lookup(_endpoint_id_for("retry-me")) is not None
+    finally:
+        server.shutdown()
+        d.shutdown()

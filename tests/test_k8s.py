@@ -1,0 +1,191 @@
+"""k8s policy parsing, ToServices translation, and watcher-driven agent.
+
+Mirrors pkg/k8s tests: CNP parse fixtures (network_policy.go tests),
+namespace scoping, NetworkPolicy peers, rule_translate.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.k8s import (K8sWatcher, parse_cnp, parse_network_policy,
+                            translate_to_services)
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.policy.api import PolicyError, Rule
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.trace import SearchContext
+from cilium_tpu.utils.option import DaemonConfig
+
+CNP = {
+    "apiVersion": "cilium.io/v2",
+    "kind": "CiliumNetworkPolicy",
+    "metadata": {"name": "web-policy", "namespace": "prod"},
+    "spec": {
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "ingress": [
+            {"fromEndpoints": [{"matchLabels": {"app": "client"}}],
+             "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}]}]},
+        ],
+    },
+}
+
+NP = {
+    "apiVersion": "networking.k8s.io/v1",
+    "kind": "NetworkPolicy",
+    "metadata": {"name": "db-np", "namespace": "prod"},
+    "spec": {
+        "podSelector": {"matchLabels": {"role": "db"}},
+        "ingress": [
+            {"from": [{"podSelector": {"matchLabels": {"role": "api"}}},
+                      {"ipBlock": {"cidr": "172.17.0.0/16",
+                                   "except": ["172.17.1.0/24"]}}],
+             "ports": [{"port": 5432, "protocol": "TCP"}]},
+        ],
+    },
+}
+
+
+def labels(*strs):
+    return LabelArray.parse_select(*strs)
+
+
+def test_parse_cnp_namespace_scoping():
+    rules = parse_cnp(CNP)
+    assert len(rules) == 1
+    r = rules[0]
+    # endpoint selector matches only pods in the prod namespace
+    prod_web = labels("k8s:app=web",
+                      "k8s:io.kubernetes.pod.namespace=prod")
+    other_web = labels("k8s:app=web",
+                       "k8s:io.kubernetes.pod.namespace=dev")
+    assert r.endpoint_selector.matches(prod_web)
+    assert not r.endpoint_selector.matches(other_web)
+    # derived policy bookkeeping labels present (delete key)
+    assert any(l.key == "io.cilium.k8s.policy.name" and
+               l.value == "web-policy" for l in r.labels)
+    # from-endpoints got scoped too
+    repo = Repository()
+    repo.add_list(rules)
+    ctx = SearchContext(
+        from_labels=labels("k8s:app=client",
+                           "k8s:io.kubernetes.pod.namespace=prod"),
+        to_labels=prod_web)
+    from cilium_tpu.policy.trace import Port
+    ctx.dports = [Port(port=80, protocol="TCP")]
+    assert str(repo.allows_ingress(ctx)) == "allowed"
+    ctx2 = SearchContext(
+        from_labels=labels("k8s:app=client",
+                           "k8s:io.kubernetes.pod.namespace=dev"),
+        to_labels=prod_web, dports=[Port(port=80, protocol="TCP")])
+    assert str(repo.allows_ingress(ctx2)) == "denied"
+
+
+def test_parse_cnp_specs_list_and_errors():
+    multi = {"metadata": {"name": "m", "namespace": "x"},
+             "specs": [CNP["spec"], CNP["spec"]]}
+    assert len(parse_cnp(multi)) == 2
+    with pytest.raises(PolicyError):
+        parse_cnp({"metadata": {"name": "n"}})  # no spec
+    with pytest.raises(PolicyError):
+        parse_cnp({"spec": CNP["spec"], "metadata": {}})  # no name
+
+
+def test_parse_network_policy_peers():
+    rules = parse_network_policy(NP)
+    assert len(rules) == 1
+    r = rules[0]
+    # two ingress rules: selector peers (with ports) + cidr peers
+    assert len(r.ingress) == 2
+    sel_rule = r.ingress[0]
+    assert sel_rule.to_ports[0].ports[0].port == "5432"
+    api_prod = labels("k8s:role=api",
+                      "k8s:io.kubernetes.pod.namespace=prod")
+    assert sel_rule.from_endpoints[0].matches(api_prod)
+    cidr_rule = r.ingress[1]
+    assert cidr_rule.from_cidr_set[0].cidr == "172.17.0.0/16"
+    assert cidr_rule.from_cidr_set[0].except_cidrs == ("172.17.1.0/24",)
+
+
+def test_translate_to_services():
+    from cilium_tpu.policy.api import (EgressRule, EndpointSelector,
+                                       K8sServiceNamespace, Service)
+    rule = Rule(endpoint_selector=EndpointSelector.parse("app=x"),
+                egress=[EgressRule(to_services=[Service(
+                    k8s_service=K8sServiceNamespace(
+                        service_name="db", namespace="prod"))])])
+    n = translate_to_services([rule], "db", "prod",
+                              ["10.0.0.5", "10.0.0.6"])
+    assert n == 1
+    cidrs = [c.cidr for c in rule.egress[0].to_cidr_set]
+    assert cidrs == ["10.0.0.5/32", "10.0.0.6/32"]
+    assert all(c.generated for c in rule.egress[0].to_cidr_set)
+    # re-translation replaces, not appends
+    translate_to_services([rule], "db", "prod", ["10.0.0.7"])
+    assert [c.cidr for c in rule.egress[0].to_cidr_set] == ["10.0.0.7/32"]
+    # other services untouched
+    assert translate_to_services([rule], "other", "prod", ["1.2.3.4"]) == 0
+
+
+def test_watcher_drives_daemon():
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        w.on_cnp("added", CNP)
+        assert len(d.repo) == 1
+        # modify replaces (same name/namespace), not duplicates
+        w.on_cnp("modified", CNP)
+        assert len(d.repo) == 1
+        # endpoints + service -> LB programmed
+        w.on_endpoints("added", {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "subsets": [{"addresses": [{"ip": "10.0.0.5"}],
+                         "ports": [{"port": 5432}]}]})
+        w.on_service("added", {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "spec": {"clusterIP": "10.96.0.10",
+                     "ports": [{"port": 5432}]}})
+        assert len(d.datapath.lb) == 1
+        svc = d.datapath.lb.services()[0]
+        assert len(svc.backends) == 1
+        # delete policy via watcher
+        w.on_cnp("deleted", CNP)
+        assert len(d.repo) == 0
+        w.on_service("deleted", {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "spec": {"clusterIP": "10.96.0.10",
+                     "ports": [{"port": 5432}]}})
+        assert len(d.datapath.lb) == 0
+        assert w.events_processed == 6
+    finally:
+        d.shutdown()
+
+
+def test_watcher_toservices_retranslation():
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        cnp = {
+            "metadata": {"name": "svc-egress", "namespace": "prod"},
+            "spec": {
+                "endpointSelector": {"matchLabels": {"app": "web"}},
+                "egress": [{"toServices": [{"k8sService": {
+                    "serviceName": "db", "namespace": "prod"}}]}],
+            },
+        }
+        # endpoints known BEFORE policy: translation happens at import
+        w.on_endpoints("added", {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "subsets": [{"addresses": [{"ip": "10.0.0.8"}]}]})
+        w.on_cnp("added", cnp)
+        rule = d.repo.rules[0]
+        assert [c.cidr for c in rule.egress[0].to_cidr_set] == \
+            ["10.0.0.8/32"]
+        # endpoints change AFTER: rules in the repo re-translate
+        w.on_endpoints("added", {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "subsets": [{"addresses": [{"ip": "10.0.0.9"}]}]})
+        rule = d.repo.rules[0]
+        assert [c.cidr for c in rule.egress[0].to_cidr_set] == \
+            ["10.0.0.9/32"]
+    finally:
+        d.shutdown()
