@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Extended benchmark suite: every BASELINE.json config.
+
+bench.py (the driver's single-metric entry) covers config 1 (CIDR+port
+100 rules). This suite adds the rest:
+
+  identity-l4  — identity-label L4 ingress at scale (many endpoints x
+                 many rules): the O(identities x rules) control-plane
+                 pain point becomes one big batched verdict table
+  http-regex   — HTTP method+path regex matching (DFA throughput)
+  kafka-acl    — Kafka topic/API-key ACL checks
+  fqdn         — DNS wildcard matchPattern evaluation
+
+Prints one JSON line per config. Usage:
+  python bench_suite.py [config ...]   (default: all)
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench(step, iters, warmup=1):
+    for _ in range(warmup):
+        step()
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        step()
+        lat.append(time.perf_counter() - t1)
+    total = time.perf_counter() - t0
+    return total, float(np.percentile(np.array(lat), 99) * 1e6)
+
+
+def _emit(metric, value, unit, target, extra):
+    print(json.dumps({
+        "metric": metric, "value": round(value),
+        "unit": unit, "vs_baseline": round(value / target, 3),
+        "extra": extra}))
+
+
+def bench_identity_l4(on_accel: bool):
+    """Config 2: identity-label L4 ingress — endpoints x rules scale."""
+    import jax
+    import jax.numpy as jnp
+    from cilium_tpu.compiler.policy_tables import compile_endpoints
+    from cilium_tpu.datapath.verdict import VerdictEngine, make_packet_batch
+    from cilium_tpu.policy.mapstate import (INGRESS, PolicyKey,
+                                            PolicyMapState,
+                                            PolicyMapStateEntry)
+    rng = np.random.default_rng(3)
+    n_endpoints = 64 if on_accel else 16
+    rules_per_ep = 1000 if on_accel else 100
+    states = []
+    for _ in range(n_endpoints):
+        st = PolicyMapState()
+        idents = rng.choice(np.arange(256, 66000), rules_per_ep,
+                            replace=False)
+        ports = rng.integers(1, 65536, rules_per_ep)
+        for ident, port in zip(idents, ports):
+            st[PolicyKey(identity=int(ident), dest_port=int(port),
+                         nexthdr=6, direction=INGRESS)] = \
+                PolicyMapStateEntry()
+        states.append(st)
+    compiled = compile_endpoints(states, revision=1)
+    eng = VerdictEngine(compiled)
+    batch = (1 << 20) if on_accel else (1 << 16)
+    pkt = make_packet_batch(
+        endpoint=rng.integers(0, n_endpoints, batch).astype(np.int32),
+        identity=rng.integers(256, 66000, batch).astype(np.int32),
+        dport=rng.integers(1, 65536, batch).astype(np.int32),
+        proto=np.full(batch, 6, np.int32),
+        direction=np.zeros(batch, np.int32),
+        length=np.full(batch, 256, np.int32))
+
+    def step():
+        eng(pkt).block_until_ready()
+
+    iters = 20 if on_accel else 5
+    total, p99 = _bench(step, iters)
+    _emit("policy_verdicts_per_sec_identity_l4",
+          iters * batch / total, "verdicts/s", 10_000_000.0,
+          {"endpoints": n_endpoints, "rules_per_endpoint": rules_per_ep,
+           "entries": compiled.entry_count(), "batch": batch,
+           "p99_batch_latency_us": round(p99, 1)})
+
+
+def bench_http_regex(on_accel: bool):
+    """Config 3: HTTP method+path regex matching."""
+    import jax.numpy as jnp
+    from cilium_tpu.l7.http import HTTPPolicyEngine, HTTPRequest
+    from cilium_tpu.policy.api import PortRuleHTTP
+    rules = [PortRuleHTTP(method="GET", path="/public/.*"),
+             PortRuleHTTP(method="GET", path="/api/v[0-9]+/users/.*"),
+             PortRuleHTTP(method="POST", path="/api/v[0-9]+/orders"),
+             PortRuleHTTP(method="PUT", path="/admin/.*",
+                          host="admin\\.example\\.com")]
+    eng = HTTPPolicyEngine(rules)
+    rng = np.random.default_rng(5)
+    batch = 8192 if on_accel else 2048
+    paths = ["/public/idx.html", "/api/v2/users/42", "/api/v2/orders",
+             "/secret/x", "/admin/panel", "/api/vX/users/1"]
+    methods = ["GET", "POST", "PUT"]
+    reqs = [HTTPRequest(method=methods[i % 3], path=paths[i % 6],
+                        host="admin.example.com")
+            for i in range(batch)]
+
+    def step():
+        v = eng.check(reqs)
+        np.asarray(v)
+
+    iters = 10 if on_accel else 3
+    total, p99 = _bench(step, iters)
+    _emit("http_requests_checked_per_sec", iters * batch / total,
+          "requests/s", 1_000_000.0,
+          {"rules": len(rules), "batch": batch,
+           "p99_batch_latency_us": round(p99, 1)})
+
+
+def bench_kafka_acl(on_accel: bool):
+    """Config 4: Kafka topic/API-key ACLs."""
+    from cilium_tpu.l7.kafka import KafkaPolicyEngine, KafkaRequest
+    from cilium_tpu.policy.api import PortRuleKafka
+    rules = [PortRuleKafka(role="consume", topic="events.page"),
+             PortRuleKafka(api_key="produce", topic="logs"),
+             PortRuleKafka(client_id="trusted-0")]
+    eng = KafkaPolicyEngine([r.sanitize() for r in rules])
+    batch = 8192 if on_accel else 2048
+    reqs = [KafkaRequest(api_key=0 if i % 2 else 1, api_version=2,
+                         correlation_id=i,
+                         topics=["events.page" if i % 3 else "logs"],
+                         client_id=f"client-{i % 7}")
+            for i in range(batch)]
+
+    def step():
+        v = eng.check(reqs)
+        np.asarray(v)
+
+    iters = 10 if on_accel else 3
+    total, p99 = _bench(step, iters)
+    _emit("kafka_requests_checked_per_sec", iters * batch / total,
+          "requests/s", 1_000_000.0,
+          {"rules": len(rules), "batch": batch,
+           "p99_batch_latency_us": round(p99, 1)})
+
+
+def bench_fqdn(on_accel: bool):
+    """Config 5: FQDN wildcard matchPattern evaluation."""
+    from cilium_tpu.l7.dns import DNSPolicyEngine
+    from cilium_tpu.policy.api import FQDNSelector
+    sels = [FQDNSelector(match_pattern="*.example.com"),
+            FQDNSelector(match_name="api.internal.svc"),
+            FQDNSelector(match_pattern="db-*.prod.local")]
+    eng = DNSPolicyEngine(sels)
+    batch = 8192 if on_accel else 2048
+    names = [f"host{i}.example.com" if i % 2 else f"db-{i}.prod.local"
+             for i in range(batch)]
+
+    def step():
+        np.asarray(eng.allowed(names))
+
+    iters = 10 if on_accel else 3
+    total, p99 = _bench(step, iters)
+    _emit("fqdn_names_checked_per_sec", iters * batch / total,
+          "names/s", 1_000_000.0,
+          {"selectors": len(sels), "batch": batch,
+           "p99_batch_latency_us": round(p99, 1)})
+
+
+CONFIGS = {
+    "identity-l4": bench_identity_l4,
+    "http-regex": bench_http_regex,
+    "kafka-acl": bench_kafka_acl,
+    "fqdn": bench_fqdn,
+}
+
+
+def main():
+    import jax
+    on_accel = jax.default_backend() != "cpu"
+    wanted = sys.argv[1:] or list(CONFIGS)
+    for name in wanted:
+        CONFIGS[name](on_accel)
+
+
+if __name__ == "__main__":
+    main()

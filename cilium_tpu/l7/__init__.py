@@ -12,3 +12,7 @@ protocols.
 from .http import HTTPPolicyEngine
 from .kafka import KafkaPolicyEngine, KafkaRequest, parse_kafka_request
 from .dns import DNSCache, DNSPolicyEngine, DNSPoller
+# imported for their REGISTRY.register side effects: without these the
+# production parsers are invisible to ProxyManager's parser instance
+from . import cassandra as _cassandra  # noqa: F401
+from . import memcached as _memcached  # noqa: F401

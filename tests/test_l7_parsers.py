@@ -1,0 +1,217 @@
+"""Cassandra + Memcached production parsers.
+
+Mirrors the reference's proxylib parser tests (cassandraparser_test,
+memcached tests): frame segmentation across chunks, per-request ACLs,
+injected deny responses.
+"""
+
+import struct
+
+import pytest
+
+from cilium_tpu.l7.cassandra import (OP_QUERY, UNAUTHORIZED_CODE,
+                                     parse_query, unauthorized_frame)
+from cilium_tpu.l7.memcached import DENY_TEXT
+from cilium_tpu.l7.parser import Instance, Op, PortRuleL7
+
+
+def rules(*dicts):
+    return [PortRuleL7.from_dict(d) for d in dicts]
+
+
+def cql_frame(query: str, opcode=OP_QUERY, stream=1,
+              version=0x04) -> bytes:
+    q = query.encode()
+    body = struct.pack(">i", len(q)) + q
+    return struct.pack(">BBhBi", version, 0, stream, opcode,
+                       len(body)) + body
+
+
+# ------------------------------------------------------------- cassandra
+
+def test_parse_query_actions_and_tables():
+    assert parse_query("SELECT * FROM ks.users WHERE id=1") == \
+        ("select", "ks.users")
+    assert parse_query("insert into ks.orders (a) values (1)") == \
+        ("insert", "ks.orders")
+    assert parse_query("UPDATE ks.users SET a=1") == ("update", "ks.users")
+    assert parse_query("DELETE FROM ks.t WHERE x=1") == ("delete", "ks.t")
+    assert parse_query("USE myks") == ("use", "myks")
+    assert parse_query("TRUNCATE ks.t") == ("truncate", "ks.t")
+    assert parse_query("garbage text") == ("", "")
+
+
+def _cass_conn(inst, l7):
+    assert inst.on_new_connection("cassandra", 1, True, 300, 400,
+                                  l7_rules=l7)
+    return 1
+
+
+def test_cassandra_acl_allow_deny_and_inject():
+    inst = Instance()
+    _cass_conn(inst, rules({"query_action": "select",
+                            "query_table": "ks.public*"}))
+    ok = inst.on_data(1, False, False,
+                      cql_frame("SELECT * FROM ks.public_posts"))
+    assert [o.op for o in ok] == [Op.PASS]
+    denied = inst.on_data(1, False, False,
+                          cql_frame("SELECT * FROM ks.secrets"))
+    assert [o.op for o in denied] == [Op.DROP, Op.INJECT]
+    # injected frame is a CQL ERROR with the Unauthorized code
+    frame = denied[1].data
+    ver, _f, stream, opcode, length = struct.unpack(">BBhBi", frame[:9])
+    assert ver & 0x80  # response direction bit
+    assert opcode == 0x00
+    (code,) = struct.unpack(">i", frame[9:13])
+    assert code == UNAUTHORIZED_CODE
+    # denied insert (action not covered by the rule)
+    denied2 = inst.on_data(1, False, False,
+                           cql_frame("INSERT INTO ks.public_x (a) "
+                                     "VALUES (1)"))
+    assert denied2[0].op == Op.DROP
+
+
+def test_cassandra_chunked_frames_and_replies():
+    inst = Instance()
+    _cass_conn(inst, rules({"query_action": "select",
+                            "query_table": "ks.t"}))
+    frame = cql_frame("SELECT * FROM ks.t")
+    # header split across chunks -> MORE with the missing byte count
+    ops = inst.on_data(1, False, False, frame[:4])
+    assert ops[0].op == Op.MORE and ops[0].n == 5
+    ops = inst.on_data(1, False, False, frame[:12])
+    assert ops[0].op == Op.MORE  # body incomplete
+    # full buffer re-presented (proxylib contract) -> PASS whole frame
+    ops = inst.on_data(1, False, False, frame + frame)
+    assert [o.op for o in ops] == [Op.PASS, Op.PASS]
+    assert ops[0].n == len(frame)
+    # replies pass opaquely
+    ops = inst.on_data(1, True, False, frame)
+    assert [o.op for o in ops] == [Op.PASS]
+    # startup/options frames pass without rules consulted
+    startup = struct.pack(">BBhBi", 4, 0, 0, 0x01, 0)
+    assert inst.on_data(1, False, False, startup)[0].op == Op.PASS
+
+
+# -------------------------------------------------------------- memcached
+
+def _mc_conn(inst, l7, conn_id=2):
+    assert inst.on_new_connection("memcache", conn_id, True, 300, 400,
+                                  l7_rules=l7)
+    return conn_id
+
+def test_memcached_text_get_set_acl():
+    inst = Instance()
+    cid = _mc_conn(inst, rules({"command": "get", "key": "sess:*"},
+                               {"command": "set", "key": "sess:*"}))
+    ops = inst.on_data(cid, False, False, b"get sess:42\r\n")
+    assert [o.op for o in ops] == [Op.PASS]
+    # multi-get: every key must be allowed
+    ops = inst.on_data(cid, False, False, b"get sess:1 other:2\r\n")
+    assert ops[0].op == Op.DROP and ops[1].data == DENY_TEXT
+    # storage command consumes its data block
+    payload = b"set sess:9 0 60 5\r\nhello\r\n"
+    ops = inst.on_data(cid, False, False, payload)
+    assert [o.op for o in ops] == [Op.PASS]
+    assert ops[0].n == len(payload)
+    ops = inst.on_data(cid, False, False, b"set other 0 60 2\r\nhi\r\n")
+    assert ops[0].op == Op.DROP
+    # delete not covered by any rule -> denied
+    ops = inst.on_data(cid, False, False, b"delete sess:42\r\n")
+    assert ops[0].op == Op.DROP
+    # keyless commands match command-only rules
+    inst2 = Instance()
+    cid2 = _mc_conn(inst2, rules({"command": "version"}), conn_id=3)
+    assert inst2.on_data(cid2, False, False,
+                         b"version\r\n")[0].op == Op.PASS
+    assert inst2.on_data(cid2, False, False,
+                         b"stats\r\n")[0].op == Op.DROP
+
+
+def test_memcached_partial_frames():
+    inst = Instance()
+    cid = _mc_conn(inst, [])
+    ops = inst.on_data(cid, False, False, b"get ses")
+    assert ops[0].op == Op.MORE
+    # storage header complete but data block missing -> MORE exact
+    ops = inst.on_data(cid, False, False, b"set k 0 0 10\r\nabc")
+    assert ops[0].op == Op.MORE
+    assert ops[0].n == len(b"set k 0 0 10\r\n") + 12 - len(
+        b"set k 0 0 10\r\nabc")
+    # replies pass through
+    assert inst.on_data(cid, True, False, b"VALUE k 0 1\r\nx\r\nEND\r\n"
+                        )[0].op == Op.PASS
+
+
+def test_memcached_binary_protocol():
+    inst = Instance()
+    cid = _mc_conn(inst, rules({"command": "get", "key": "ok*"}))
+
+    def bin_get(key: bytes) -> bytes:
+        return struct.pack(">BBHBBHIIQ", 0x80, 0x00, len(key), 0, 0, 0,
+                           len(key), 7, 0) + key
+
+    ops = inst.on_data(cid, False, False, bin_get(b"ok:1"))
+    assert [o.op for o in ops] == [Op.PASS]
+    ops = inst.on_data(cid, False, False, bin_get(b"secret"))
+    assert ops[0].op == Op.DROP and ops[1].op == Op.INJECT
+    # injected binary error response: magic 0x81, status access-denied
+    magic, opcode, _kl, _el, _dt, status = struct.unpack(
+        ">BBHBBH", ops[1].data[:8])
+    assert magic == 0x81 and status == 0x08
+    # partial binary header -> MORE
+    ops = inst.on_data(cid, False, False, bin_get(b"ok:1")[:10])
+    assert ops[0].op == Op.MORE and ops[0].n == 14
+    # registry also answers to "memcached"
+    inst2 = Instance()
+    assert inst2.on_new_connection("memcached", 9, True, 1, 2)
+
+
+# --------------------------------------------- review-regression coverage
+
+def test_cassandra_batch_frames_enforced():
+    from cilium_tpu.l7.cassandra import OP_BATCH
+
+    def batch_frame(queries, stream=1):
+        body = bytes([0]) + struct.pack(">H", len(queries))
+        for q in queries:
+            qb = q.encode()
+            body += bytes([0]) + struct.pack(">i", len(qb)) + qb
+            body += struct.pack(">H", 0)  # no values
+        return struct.pack(">BBhBi", 4, 0, stream, OP_BATCH,
+                           len(body)) + body
+
+    inst = Instance()
+    _cass_conn(inst, rules({"query_action": "insert",
+                            "query_table": "ks.audit"}))
+    ok = inst.on_data(1, False, False, batch_frame(
+        ["INSERT INTO ks.audit (a) VALUES (1)",
+         "INSERT INTO ks.audit (a) VALUES (2)"]))
+    assert [o.op for o in ok] == [Op.PASS]
+    # one denied statement denies the whole batch
+    denied = inst.on_data(1, False, False, batch_frame(
+        ["INSERT INTO ks.audit (a) VALUES (1)",
+         "SELECT * FROM ks.secrets"]))
+    assert [o.op for o in denied] == [Op.DROP, Op.INJECT]
+    # malformed batch fails closed
+    garbage = struct.pack(">BBhBi", 4, 0, 1, OP_BATCH, 3) + b"\xff\xff\xff"
+    bad = inst.on_data(1, False, False, garbage)
+    assert bad[0].op == Op.DROP
+
+
+def test_parsers_registered_via_package_import():
+    import importlib
+    import cilium_tpu.l7 as l7pkg
+    importlib.reload(l7pkg)
+    from cilium_tpu.l7.parser import REGISTRY
+    assert "cassandra" in REGISTRY.protocols()
+    assert "memcache" in REGISTRY.protocols()
+
+
+def test_memcached_rejects_hostile_bytes_field():
+    inst = Instance()
+    cid = _mc_conn(inst, [], conn_id=5)
+    ops = inst.on_data(cid, False, False, b"set x 0 0 -16\r\nget y\r\n")
+    assert ops[0].op == Op.ERROR
+    ops = inst.on_data(cid, False, False, b"set k 0 0 4294967295\r\n")
+    assert ops[0].op == Op.ERROR
