@@ -24,7 +24,8 @@ from .. import identity as idpkg
 from ..clustermesh import ClusterMesh
 from ..datapath.engine import Datapath
 from ..datapath.lb import Backend, Service
-from ..endpoint import Endpoint, EndpointManager, EndpointState
+from ..endpoint import (DeviceTableManager, Endpoint, EndpointManager,
+                        EndpointState)
 from ..identity import (Identity, IdentityCache, LocalIdentityAllocator)
 from ..ipcache import (SOURCE_AGENT_LOCAL, IPCache, IPIdentityWatcher,
                        KVStoreIPCacheSyncer, allocate_cidr_identities,
@@ -63,6 +64,11 @@ class Daemon:
                                   self.config.proxy_port_max)
         self.controllers = ControllerManager()
         self.datapath = Datapath(ct_slots=self.config.ct_slots)
+        # incremental policy realization: one endpoint's regeneration
+        # writes one device-table row (syncPolicyMap analog); the
+        # engine re-jits only when the stack's geometry grows
+        self.table_mgr = DeviceTableManager()
+        self.datapath.use_table_manager(self.table_mgr)
         # host fast path: C++ per-endpoint verdict caches (the eBPF
         # hit-path analog); optional — the TPU path works without it
         try:
@@ -327,24 +333,15 @@ class Daemon:
             # cache; re-check so we never resurrect a deleted endpoint
             if self.endpoints.lookup(ep.id) is None:
                 self.host_path.remove_endpoint(ep.id)
-        self._reload_datapath_policy()
+        # incremental device sync: this endpoint's row only
+        # (endpoint/bpf.go:607 syncPolicyMap analog)
+        self.table_mgr.sync_endpoint(ep.id, ep.realized, res.revision)
+        self.datapath.refresh_policy(res.revision)
         if self.config.state_dir:
             try:
                 ep.write_checkpoint(self.config.state_dir)
             except OSError:
                 pass
-
-    def _reload_datapath_policy(self) -> None:
-        """Stack all endpoints' realized states into the datapath
-        (policy table swap; revision = repo revision)."""
-        eps = sorted(self.endpoints.endpoints(), key=lambda e: e.id)
-        with self._lock:
-            slot_states = [ep.realized for ep in eps]
-            for slot, ep in enumerate(eps):
-                ep.table_slot = slot
-            self.datapath.load_policy(
-                slot_states, revision=self.repo.revision,
-                ipcache_prefixes=self.ipcache.to_lpm_prefixes())
 
     # -------------------------------------------------- endpoints
 
@@ -357,6 +354,7 @@ class Daemon:
         ep = Endpoint(endpoint_id, ipv4=ipv4,
                       container_name=container_name,
                       opts=self.config.opts.fork())
+        ep.table_slot = self.table_mgr.attach(endpoint_id)
         self.endpoints.insert(ep)
         ep.update_labels(self.identity_allocator,
                          Labels.from_model(list(labels or [])))
@@ -390,7 +388,8 @@ class Daemon:
                                        f"ep_{endpoint_id}.json"))
             except OSError:
                 pass
-        self._reload_datapath_policy()
+        self.table_mgr.detach(endpoint_id)
+        self.datapath.refresh_policy()
         return True
 
     def endpoint_update_labels(self, endpoint_id: int,
@@ -453,6 +452,7 @@ class Daemon:
                 ep = Endpoint.restore(snap)
             except (OSError, ValueError, KeyError):
                 continue
+            ep.table_slot = self.table_mgr.attach(ep.id)
             self.endpoints.insert(ep)
             ep.update_labels(self.identity_allocator, ep.labels)
             if ep.ipv4:

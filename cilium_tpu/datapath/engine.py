@@ -52,6 +52,10 @@ class Datapath:
         self.revision = 0
         self._step = None
         self._tables: Optional[FullTables] = None
+        # incremental mode: policy tensors owned by a DeviceTableManager
+        # (endpoint/tables.py); row syncs swap tensors without re-jit
+        self._table_mgr = None
+        self._mgr_geometry = None  # (capacity, slots, max_probe, gen)
 
     # -- table loading -------------------------------------------------------
 
@@ -60,6 +64,7 @@ class Datapath:
                     ipcache_prefixes: Optional[Dict[str, int]] = None
                     ) -> None:
         with self._lock:
+            self._table_mgr = None
             self.compiled_policy = compile_endpoints(map_states,
                                                      revision=revision)
             if ipcache_prefixes is not None or \
@@ -67,6 +72,41 @@ class Datapath:
                 self.compiled_ipcache = compile_lpm(ipcache_prefixes or {})
             self.revision = revision
             self._rebuild()
+
+    def use_table_manager(self, mgr,
+                          ipcache_prefixes: Optional[Dict[str, int]]
+                          = None) -> None:
+        """Switch policy tensors to a DeviceTableManager (incremental
+        mode): per-endpoint syncs become row writes realized by
+        refresh_policy(); only geometry changes (capacity/slot growth,
+        longer probe chains) re-jit the step."""
+        with self._lock:
+            self._table_mgr = mgr
+            if ipcache_prefixes is not None or \
+                    self.compiled_ipcache is None:
+                self.compiled_ipcache = compile_lpm(ipcache_prefixes or {})
+            self._rebuild()
+
+    def refresh_policy(self, revision: Optional[int] = None) -> bool:
+        """Realize the table manager's current tensors (the syncPolicyMap
+        fast path: no recompile when geometry is unchanged). Returns
+        True when a full re-jit happened."""
+        with self._lock:
+            if self._table_mgr is None:
+                raise RuntimeError("not in table-manager mode")
+            if revision is not None:
+                self.revision = max(self.revision, revision)
+            mgr = self._table_mgr
+            geometry = (mgr.capacity, mgr.slots, mgr.max_probe,
+                        mgr.generation)
+            if geometry != self._mgr_geometry or self._step is None:
+                self._rebuild()
+                return True
+            key_id, key_meta, value = mgr.tensors()
+            dp = self._tables.datapath._replace(
+                key_id=key_id, key_meta=key_meta, value=value)
+            self._tables = self._tables._replace(datapath=dp)
+            return False
 
     def load_ipcache(self, prefixes: Dict[str, int]) -> None:
         with self._lock:
@@ -82,11 +122,32 @@ class Datapath:
             self._rebuild()
 
     def _rebuild(self) -> None:
-        if self.compiled_policy is None:
+        if self._table_mgr is None and self.compiled_policy is None:
             return
         if self.lb.compiled is None:
             self.lb._recompile()
-        dp = build_tables(self.compiled_policy, self.compiled_ipcache)
+        if self._table_mgr is not None:
+            mgr = self._table_mgr
+            key_id, key_meta, value = mgr.tensors()
+            if self.compiled_ipcache is None:
+                self.compiled_ipcache = compile_lpm({})
+            lpm = self.compiled_ipcache
+            dp = DatapathTables(
+                key_id=key_id, key_meta=key_meta, value=value,
+                lpm_masks=jnp.asarray(lpm.masks),
+                lpm_key_a=jnp.asarray(lpm.key_a),
+                lpm_key_b=jnp.asarray(lpm.key_b),
+                lpm_value=jnp.asarray(lpm.value),
+                lpm_plens=jnp.asarray(lpm.prefix_lens))
+            policy_probe = max(1, mgr.max_probe)
+            n = max(1, mgr.capacity * mgr.slots)
+            self._mgr_geometry = (mgr.capacity, mgr.slots, mgr.max_probe,
+                                  mgr.generation)
+        else:
+            dp = build_tables(self.compiled_policy, self.compiled_ipcache)
+            policy_probe = self.compiled_policy.max_probe
+            n = max(1, self.compiled_policy.num_endpoints *
+                    self.compiled_policy.slots)
         pf = self.prefilter._compiled
         if pf is None or pf.entry_count() == 0:
             pf = compile_lpm({})
@@ -95,14 +156,12 @@ class Datapath:
             pf_masks=jnp.asarray(pf.masks), pf_key_a=jnp.asarray(pf.key_a),
             pf_key_b=jnp.asarray(pf.key_b), pf_value=jnp.asarray(pf.value),
             pf_plens=jnp.asarray(pf.prefix_lens))
-        n = max(1, self.compiled_policy.num_endpoints *
-                self.compiled_policy.slots)
         if self.counters is None or self.counters.packets.shape[0] != n:
             self.counters = Counters(packets=jnp.zeros(n, jnp.uint32),
                                      bytes=jnp.zeros(n, jnp.uint32))
         self._step = jax.jit(functools.partial(
             full_datapath_step,
-            policy_probe=self.compiled_policy.max_probe,
+            policy_probe=policy_probe,
             lpm_probe=max(1, self.compiled_ipcache.max_probe),
             pf_probe=max(1, pf.max_probe),
             lb_probe=self.lb.compiled.max_probe,

@@ -14,6 +14,7 @@ spec; rule semantics mirror the reference's fields.
 
 from __future__ import annotations
 
+import hashlib
 import re
 import struct
 from typing import Dict, List, Optional, Tuple
@@ -57,8 +58,20 @@ _TABLE_RES = {
 UNAUTHORIZED_CODE = 0x2100  # CQL Unauthorized error
 
 
+_COMMENT_RE = re.compile(r"^(\s*(/\*.*?\*/|--[^\n]*\n|//[^\n]*\n))*",
+                         re.DOTALL)
+
+
+def strip_comments(query: str) -> str:
+    """Remove leading CQL comments so '/**/SELECT ...' cannot hide its
+    action from the ACL (the comment-bypass the reference's parser
+    explicitly guards against)."""
+    return _COMMENT_RE.sub("", query, count=1)
+
+
 def parse_query(query: str) -> Tuple[str, str]:
     """CQL text -> (action, table) ('' when not applicable)."""
+    query = strip_comments(query)
     m = _ACTION_RE.match(query)
     if not m:
         return "", ""
@@ -94,8 +107,9 @@ def rule_allows(rules, action: str, table: str) -> bool:
     return False
 
 
-def parse_batch_queries(body: bytes) -> Optional[List[str]]:
-    """Walk an OP_BATCH body and return its kind-0 query strings.
+def parse_batch_statements(body: bytes
+                           ) -> Optional[List[Tuple[int, object]]]:
+    """Walk an OP_BATCH body: [(0, query_str) | (1, prepared_id)].
 
     Layout (CQL spec): [type u8][n u16] then per statement:
     [kind u8] + (kind 0: [long string] | kind 1: [short bytes id]),
@@ -106,21 +120,22 @@ def parse_batch_queries(body: bytes) -> Optional[List[str]]:
         off = 0
         _btype = body[off]; off += 1
         (n,) = struct.unpack_from(">H", body, off); off += 2
-        queries: List[str] = []
+        out: List[Tuple[int, object]] = []
         for _ in range(n):
             kind = body[off]; off += 1
             if kind == 0:
                 (qlen,) = struct.unpack_from(">i", body, off); off += 4
                 if qlen < 0 or off + qlen > len(body):
                     return None
-                queries.append(body[off:off + qlen]
-                               .decode("utf-8", "replace"))
+                out.append((0, body[off:off + qlen]
+                            .decode("utf-8", "replace")))
                 off += qlen
             elif kind == 1:
                 (idlen,) = struct.unpack_from(">H", body, off); off += 2
                 if off + idlen > len(body):
                     return None
-                off += idlen  # prepared id: enforced at PREPARE time
+                out.append((1, body[off:off + idlen]))
+                off += idlen
             else:
                 return None
             (n_values,) = struct.unpack_from(">H", body, off); off += 2
@@ -131,7 +146,7 @@ def parse_batch_queries(body: bytes) -> Optional[List[str]]:
                         return None
                     off += vlen
                 # vlen < 0 == null value: no bytes follow
-        return queries
+        return out
     except (IndexError, struct.error):
         return None
 
@@ -147,8 +162,23 @@ def unauthorized_frame(version: int, stream: int, msg: str) -> bytes:
     return header + body
 
 
+def prepared_id(query: str) -> bytes:
+    """Cassandra's prepared-statement id is the MD5 of the query text
+    (server-global and deterministic), so the proxy can precompute it
+    at PREPARE time and enforce the same ACL at EXECUTE time —
+    otherwise EXECUTE of a statement prepared by a more-privileged
+    client bypasses the policy."""
+    return hashlib.md5(query.encode()).digest()
+
+
 class CassandraParser(Parser):
-    """Frame segmentation + per-QUERY ACL."""
+    """Frame segmentation + per-QUERY ACL (fail closed: statements the
+    parser cannot attribute to an action are denied when rules exist)."""
+
+    def __init__(self, connection):
+        super().__init__(connection)
+        # prepared id -> (action, table) learned from allowed PREPAREs
+        self._prepared: Dict[bytes, Tuple[str, str]] = {}
 
     def on_data(self, reply: bool, end_stream: bool,
                 data: bytes) -> List[OpResult]:
@@ -181,46 +211,83 @@ class CassandraParser(Parser):
     def _request_frame(self, version: int, stream: int, opcode: int,
                        body: bytes, frame_len: int) -> List[OpResult]:
         conn = self.connection
-        action, table = "", ""
-        if opcode in (OP_QUERY, OP_PREPARE) and len(body) >= 4:
-            (qlen,) = struct.unpack(">i", body[:4])
-            if 0 <= qlen <= len(body) - 4:
-                query = body[4:4 + qlen].decode("utf-8", "replace")
-                action, table = parse_query(query)
-        elif opcode == OP_BATCH:
+
+        def deny(msg: str) -> List[OpResult]:
+            return [DROP(frame_len),
+                    INJECT(unauthorized_frame(version, stream, msg))]
+
+        def check(action: str, table: str) -> bool:
+            return rule_allows(conn.l7_rules, action, table)
+
+        unrestricted = not conn.l7_rules
+
+        if opcode in (OP_QUERY, OP_PREPARE):
+            query = None
+            if len(body) >= 4:
+                (qlen,) = struct.unpack(">i", body[:4])
+                if 0 <= qlen <= len(body) - 4:
+                    query = body[4:4 + qlen].decode("utf-8", "replace")
+            if query is None:
+                return deny("Malformed query frame denied")
+            action, table = parse_query(query)
+            if not action and not unrestricted:
+                # statements we cannot attribute fail closed — the
+                # comment-prefix bypass the reference guards against
+                return deny("Unparseable statement denied by policy")
+            if action and not check(action, table):
+                return deny(f"Request on table [{table}] denied "
+                            f"by policy")
+            if opcode == OP_PREPARE:
+                self._prepared[prepared_id(query)] = (action, table)
+            return [PASS(frame_len)]
+
+        if opcode == OP_EXECUTE:
+            if unrestricted:
+                return [PASS(frame_len)]
+            # [short bytes] prepared id leads the body
+            if len(body) < 2:
+                return deny("Malformed execute frame denied")
+            (idlen,) = struct.unpack(">H", body[:2])
+            pid = body[2:2 + idlen]
+            known = self._prepared.get(pid)
+            if known is None:
+                # prepared ids are server-global: executing an id this
+                # connection never prepared would bypass the ACL
+                return deny("Execute of unknown prepared statement "
+                            "denied by policy")
+            action, table = known
+            if action and not check(action, table):
+                return deny(f"Request on table [{table}] denied "
+                            f"by policy")
+            return [PASS(frame_len)]
+
+        if opcode == OP_BATCH:
             # every statement in the batch must pass the ACL; a batch
             # we cannot parse fails closed (otherwise it would be an
             # ACL bypass wrapper)
-            queries = parse_batch_queries(body)
-            if queries is None:
-                return [DROP(frame_len),
-                        INJECT(unauthorized_frame(
-                            version, stream, "Unparseable batch denied"))]
-            for q in queries:
-                b_action, b_table = parse_query(q)
-                if b_action and not rule_allows(conn.l7_rules, b_action,
-                                                b_table):
-                    return [DROP(frame_len),
-                            INJECT(unauthorized_frame(
-                                version, stream,
-                                f"Batch request on table [{b_table}] "
-                                f"denied by policy"))]
-            return [PASS(frame_len)]
-        elif opcode not in OPCODE_NAMES:
-            # unknown opcode: pass through (fail open on protocol
-            # evolution, like the reference's default branch)
+            stmts = parse_batch_statements(body)
+            if stmts is None:
+                return deny("Unparseable batch denied")
+            for kind, value in stmts:
+                if kind == 1:
+                    known = self._prepared.get(value)
+                    if known is None and not unrestricted:
+                        return deny("Batch execute of unknown prepared "
+                                    "statement denied by policy")
+                    b_action, b_table = known or ("", "")
+                else:
+                    b_action, b_table = parse_query(value)
+                    if not b_action and not unrestricted:
+                        return deny("Unparseable batch statement denied "
+                                    "by policy")
+                if b_action and not check(b_action, b_table):
+                    return deny(f"Batch request on table [{b_table}] "
+                                f"denied by policy")
             return [PASS(frame_len)]
 
-        # connection-level ops (startup/options/register/auth) always
-        # pass; only data-bearing actions are policy-checked
-        if not action:
-            return [PASS(frame_len)]
-        if rule_allows(conn.l7_rules, action, table):
-            return [PASS(frame_len)]
-        return [DROP(frame_len),
-                INJECT(unauthorized_frame(
-                    version, stream,
-                    f"Request on table [{table}] denied by policy"))]
+        # connection-level ops (startup/options/register/auth) and
+        # unknown opcodes pass: they carry no data access
+        return [PASS(frame_len)]
 
 
 REGISTRY.register("cassandra", CassandraParser)
