@@ -62,12 +62,17 @@ def _time_engine(step, iters):
     return time.perf_counter() - t0, lat
 
 
-def main():
+def run_bench():
+    # Honor the platform chosen by the watchdog parent (see main below):
+    # the axon sitecustomize overrides JAX_PLATFORMS at interpreter start,
+    # so it must be re-applied via jax.config after import.
+    from cilium_tpu.utils.platform import apply_env_platform
+    backend, on_accel = apply_env_platform()
+
     import jax
     import jax.numpy as jnp
 
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
-    on_accel = jax.default_backend() != "cpu"
     if not on_accel and len(sys.argv) <= 1:
         batch = 1 << 17  # CPU smoke runs use a smaller default
 
@@ -150,11 +155,21 @@ def main():
                   "p99_batch_latency_us": round(p99_us, 1),
                   "hash_probe_vps": round(probe_iters * batch / h_probe),
                   "dense_probe_vps": round(probe_iters * batch / d_probe),
+                  "backend": backend, "on_accel": on_accel,
                   "device": str(jax.devices()[0]),
                   "policy_entries": compiled_policy.entry_count(),
                   "dense_entries": n_entries,
                   "lpm_entries": compiled_lpm.entry_count()},
     }))
+
+
+def main():
+    # Round 1 lost its only TPU data point to a wedged relay: backend init
+    # (or the first compile) can hang forever in native code.  Run the
+    # benchmark body in a watchdogged subprocess — accelerator first, CPU
+    # re-run on crash/stall — so this script always emits one JSON line.
+    from cilium_tpu.utils.platform import main_with_fallback
+    main_with_fallback(run_bench)
 
 
 if __name__ == "__main__":

@@ -178,12 +178,17 @@ CONFIGS = {
 }
 
 
-def main():
-    import jax
-    on_accel = jax.default_backend() != "cpu"
+def run_suite():
+    from cilium_tpu.utils.platform import apply_env_platform
+    _backend, on_accel = apply_env_platform()
     wanted = sys.argv[1:] or list(CONFIGS)
     for name in wanted:
         CONFIGS[name](on_accel)
+
+
+def main():
+    from cilium_tpu.utils.platform import main_with_fallback
+    main_with_fallback(run_suite, timeout=900, fail_metric="suite_failed")
 
 
 if __name__ == "__main__":

@@ -96,13 +96,14 @@ class Datapath:
                 raise RuntimeError("not in table-manager mode")
             if revision is not None:
                 self.revision = max(self.revision, revision)
-            mgr = self._table_mgr
-            geometry = (mgr.capacity, mgr.slots, mgr.max_probe,
-                        mgr.generation)
+            # one atomic (geometry, tensors) snapshot: a concurrent
+            # sync_endpoint can lengthen probe chains in-place and a
+            # grow can reshape the stack between separate reads
+            geometry, tensors = self._table_mgr.snapshot()
             if geometry != self._mgr_geometry or self._step is None:
-                self._rebuild()
+                self._rebuild(mgr_snapshot=(geometry, tensors))
                 return True
-            key_id, key_meta, value = mgr.tensors()
+            key_id, key_meta, value = tensors
             dp = self._tables.datapath._replace(
                 key_id=key_id, key_meta=key_meta, value=value)
             self._tables = self._tables._replace(datapath=dp)
@@ -121,14 +122,16 @@ class Datapath:
         with self._lock:
             self._rebuild()
 
-    def _rebuild(self) -> None:
+    def _rebuild(self, mgr_snapshot=None) -> None:
         if self._table_mgr is None and self.compiled_policy is None:
             return
         if self.lb.compiled is None:
             self.lb._recompile()
         if self._table_mgr is not None:
-            mgr = self._table_mgr
-            key_id, key_meta, value = mgr.tensors()
+            if mgr_snapshot is None:
+                mgr_snapshot = self._table_mgr.snapshot()
+            geometry, (key_id, key_meta, value) = mgr_snapshot
+            capacity, slots, max_probe, _gen = geometry
             if self.compiled_ipcache is None:
                 self.compiled_ipcache = compile_lpm({})
             lpm = self.compiled_ipcache
@@ -139,10 +142,9 @@ class Datapath:
                 lpm_key_b=jnp.asarray(lpm.key_b),
                 lpm_value=jnp.asarray(lpm.value),
                 lpm_plens=jnp.asarray(lpm.prefix_lens))
-            policy_probe = max(1, mgr.max_probe)
-            n = max(1, mgr.capacity * mgr.slots)
-            self._mgr_geometry = (mgr.capacity, mgr.slots, mgr.max_probe,
-                                  mgr.generation)
+            policy_probe = max(1, max_probe)
+            n = max(1, capacity * slots)
+            self._mgr_geometry = geometry
         else:
             dp = build_tables(self.compiled_policy, self.compiled_ipcache)
             policy_probe = self.compiled_policy.max_probe

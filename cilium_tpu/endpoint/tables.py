@@ -202,6 +202,21 @@ class DeviceTableManager:
         with self._lock:
             return self.key_id, self.key_meta, self.value
 
+    def snapshot(self):
+        """Atomic (geometry, tensors) pair under one lock acquisition.
+
+        Consumers that first read geometry and then fetch tensors in a
+        second call can interleave with a concurrent sync_endpoint that
+        lengthens a probe chain in-place (no generation bump) or a grow
+        that reshapes the stack — installing tensors under a step jitted
+        for stale geometry.  geometry = (capacity, slots, max_probe,
+        generation).
+        """
+        with self._lock:
+            return ((self.capacity, self.slots, self.max_probe,
+                     self.generation),
+                    (self.key_id, self.key_meta, self.value))
+
     def host_mirror(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         with self._lock:
             return (self._h_key_id.copy(), self._h_key_meta.copy(),

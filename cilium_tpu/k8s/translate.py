@@ -8,9 +8,28 @@ Endpoints add/delete events re-translate affected rules
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Sequence
+import ipaddress
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from ..policy.api import CIDRRule, Rule
+
+
+def _parse_ips(ips) -> List:
+    out = []
+    for ip in ips:
+        try:
+            out.append(ipaddress.ip_address(ip))
+        except ValueError:
+            continue
+    return out
+
+
+def _covers_any(cidr: str, parsed_ips) -> bool:
+    try:
+        net = ipaddress.ip_network(cidr, strict=False)
+    except ValueError:
+        return False
+    return any(ip in net for ip in parsed_ips)
 
 
 def endpoints_to_ips(endpoints_obj: Dict) -> List[str]:
@@ -26,14 +45,25 @@ def endpoints_to_ips(endpoints_obj: Dict) -> List[str]:
 
 def translate_to_services(rules: Sequence[Rule], service_name: str,
                           namespace: str,
-                          backend_ips: Iterable[str]) -> int:
+                          backend_ips: Iterable[str],
+                          old_backend_ips: Optional[Iterable[str]] = None
+                          ) -> int:
     """Rewrite every egress ToServices reference to (service, ns) into
     generated ToCIDRSet entries. Returns rules touched.
 
-    Reference: rule_translate.go RuleTranslator.Translate — existing
-    generated entries for the service are replaced (delete-then-add on
-    Endpoints change).
+    Reference: rule_translate.go RuleTranslator.Translate — only
+    generated entries *belonging to this service* are replaced
+    (deleteToCidrFromEndpoint removes generated CIDRs containing the
+    service's endpoint IPs).  A rule can carry ToServices for several
+    services; wiping every generated entry on one service's Endpoints
+    event would transiently deny the other services' traffic.
     """
+    backend_ips = list(backend_ips)
+    # entries to drop: this service's previous backends plus its new
+    # ones (replace-in-place when an IP is unchanged); parsed once so
+    # the per-entry containment check is O(entries x ips) comparisons,
+    # not string parses
+    remove_ips = _parse_ips(set(old_backend_ips or []) | set(backend_ips))
     touched = 0
     for rule in rules:
         changed = False
@@ -45,7 +75,9 @@ def translate_to_services(rules: Sequence[Rule], service_name: str,
                 for s in eg.to_services)
             if not hit:
                 continue
-            keep = [c for c in eg.to_cidr_set if not c.generated]
+            keep = [c for c in eg.to_cidr_set
+                    if not (c.generated and _covers_any(c.cidr,
+                                                        remove_ips))]
             gen = [CIDRRule(cidr=f"{ip}/32" if ":" not in ip
                             else f"{ip}/128", generated=True)
                    for ip in backend_ips]

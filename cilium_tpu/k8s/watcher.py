@@ -99,10 +99,16 @@ class K8sWatcher:
         meta = obj.get("metadata") or {}
         key = (meta.get("namespace", "default"), meta.get("name", ""))
         ips = [] if action == "deleted" else endpoints_to_ips(obj)
-        with self._lock:
-            self._endpoints[key] = ips
         rules = self.daemon.repo.rules
-        touched = translate_to_services(rules, key[1], key[0], ips)
+        with self._lock:
+            # translate inside the lock: two events for the same service
+            # applied out of order would leave a decommissioned
+            # backend's generated CIDR allowed forever (old_ips of the
+            # later event would never name it again)
+            old_ips = self._endpoints.get(key, [])
+            self._endpoints[key] = ips
+            touched = translate_to_services(rules, key[1], key[0], ips,
+                                            old_backend_ips=old_ips)
         if touched:
             # the new backend /32s need CIDR identities + ipcache
             # entries before the regenerated policy can match them

@@ -30,13 +30,21 @@ DENY_TEXT = b"SERVER_ERROR access denied by policy\r\n"
 
 BINARY_REQUEST_MAGIC = 0x80
 BINARY_HEADER_LEN = 24
-# binary opcode -> text command family (memcached binary spec)
+# binary opcode -> text command family (memcached binary spec).
+# Quiet (suppressed-response) variants MUST map to the same family as
+# their loud counterparts — omitting them lets a client bypass the
+# whole ACL with e.g. SetQ (reference: proxylib/memcached/parser.go
+# MemcacheOpCodeMap maps 0x11-0x1A alongside 0x00-0x10).
 BINARY_OPCODES = {
     0x00: "get", 0x01: "set", 0x02: "add", 0x03: "replace",
     0x04: "delete", 0x05: "incr", 0x06: "decr", 0x07: "quit",
     0x08: "flush_all", 0x09: "get", 0x0A: "noop", 0x0B: "version",
     0x0C: "get", 0x0D: "get", 0x0E: "append", 0x0F: "prepend",
-    0x10: "stats", 0x1C: "touch", 0x1D: "gat", 0x1E: "gat",
+    0x10: "stats",
+    0x11: "set", 0x12: "add", 0x13: "replace", 0x14: "delete",
+    0x15: "incr", 0x16: "decr", 0x17: "quit", 0x18: "flush_all",
+    0x19: "append", 0x1A: "prepend",
+    0x1C: "touch", 0x1D: "gat", 0x1E: "gat",
 }
 STATUS_ACCESS_DENIED = 0x08  # "Authentication error" family
 
@@ -135,7 +143,14 @@ class MemcachedParser(Parser):
         elif command in OTHER_KEY_COMMANDS:
             keys = parts[1:2]
         elif command not in KEYLESS_COMMANDS:
-            # unknown command: pass through, server will reject
+            # Unknown command (e.g. meta commands mg/ms): when rules
+            # exist we cannot key-check it OR know its payload length,
+            # so dropping just the line would desync the stream (the
+            # payload re-parses as commands).  Fail the parse — the
+            # proxy resets the connection (proxylib parse-error
+            # semantics).  Without rules, pass best-effort.
+            if self.connection.l7_rules:
+                return [ERROR()], 0
             return [PASS(frame_len)], frame_len
         if rule_allows(self.connection.l7_rules, command, keys):
             return [PASS(frame_len)], frame_len
@@ -158,6 +173,11 @@ class MemcachedParser(Parser):
         key_start = BINARY_HEADER_LEN + extras_len
         key = data[key_start:key_start + key_len].decode("latin1")
         keys = [key] if key else []
+        if not command and self.connection.l7_rules:
+            # Unmapped opcode with rules present: fail closed (an
+            # unknown mutation opcode must not slip past the ACL).
+            return [DROP(total),
+                    INJECT(deny_binary_frame(opcode, opaque))], total
         if not command or rule_allows(self.connection.l7_rules,
                                       command, keys):
             return [PASS(total)], total

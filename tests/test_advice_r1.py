@@ -1,0 +1,235 @@
+"""Round-1 advisor regression tests (ADVICE.md).
+
+1. memcached binary quiet-opcode / unknown-opcode fail-open (high)
+2. Datapath.refresh_policy vs DeviceTableManager geometry race (medium)
+3. translate_to_services wiping other services' generated CIDRs (medium)
+4. memcached unknown text command fail-open (low)
+"""
+
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from cilium_tpu.l7.parser import Instance, Op, PortRuleL7
+
+
+def rules(*dicts):
+    return [PortRuleL7.from_dict(d) for d in dicts]
+
+
+def _mc(inst, l7, conn_id=1):
+    assert inst.on_new_connection("memcache", conn_id, True, 300, 400,
+                                  l7_rules=l7)
+    return conn_id
+
+
+def bin_frame(opcode: int, key: bytes, extras: bytes = b"") -> bytes:
+    body = extras + key
+    return struct.pack(">BBHBBHIIQ", 0x80, opcode, len(key),
+                       len(extras), 0, 0, len(body), 7, 0) + body
+
+
+# --------------------------------------------------- memcached fail-open
+
+QUIET_MUTATIONS = {
+    0x11: "set", 0x12: "add", 0x13: "replace", 0x14: "delete",
+    0x15: "incr", 0x16: "decr", 0x19: "append", 0x1A: "prepend",
+}
+
+
+def test_quiet_binary_opcodes_enforced():
+    """SetQ/AddQ/... must hit the same ACL as their loud variants —
+    the round-1 map omitted them, so `setq` bypassed the policy."""
+    inst = Instance()
+    cid = _mc(inst, rules({"command": "get", "key": "ok*"}))
+    for opcode in QUIET_MUTATIONS:
+        extras = b"\x00" * 8 if opcode in (0x11, 0x12, 0x13) else b""
+        ops = inst.on_data(cid, False, False,
+                           bin_frame(opcode, b"ok:1", extras))
+        assert ops[0].op == Op.DROP, hex(opcode)
+        assert ops[1].op == Op.INJECT
+
+
+def test_quiet_opcodes_allowed_when_rule_matches():
+    inst = Instance()
+    cid = _mc(inst, rules({"command": "set", "key": "sess:*"}))
+    # SetQ on an allowed key passes
+    ops = inst.on_data(cid, False, False,
+                       bin_frame(0x11, b"sess:1", b"\x00" * 8))
+    assert [o.op for o in ops] == [Op.PASS]
+
+
+def test_unknown_binary_opcode_fails_closed_with_rules():
+    inst = Instance()
+    cid = _mc(inst, rules({"command": "get", "key": "*"}))
+    ops = inst.on_data(cid, False, False, bin_frame(0x7F, b"k"))
+    assert ops[0].op == Op.DROP and ops[1].op == Op.INJECT
+    # status = access denied in the injected response
+    status = struct.unpack(">BBHBBH", ops[1].data[:8])[5]
+    assert status == 0x08
+
+
+def test_unknown_binary_opcode_passes_without_rules():
+    inst = Instance()
+    cid = _mc(inst, [])
+    ops = inst.on_data(cid, False, False, bin_frame(0x7F, b"k"))
+    assert [o.op for o in ops] == [Op.PASS]
+
+
+def test_unknown_text_command_fails_closed_with_rules():
+    """Meta commands (mg/ms) must not bypass the key ACL.  The parser
+    cannot know an unknown command's payload length, so it fails the
+    parse (connection reset) rather than dropping just the line and
+    desyncing on the payload."""
+    inst = Instance()
+    cid = _mc(inst, rules({"command": "get", "key": "*"}))
+    ops = inst.on_data(cid, False, False, b"ms somekey 5\r\nhello\r\n")
+    assert ops[0].op == Op.ERROR
+    inst2 = Instance()
+    cid2 = _mc(inst2, [], conn_id=2)
+    ops = inst2.on_data(cid2, False, False, b"mg somekey v\r\n")
+    assert ops[0].op == Op.PASS
+
+
+# --------------------------------- table-manager snapshot vs refresh race
+
+def test_snapshot_is_atomic_under_concurrent_sync():
+    """snapshot() must return geometry consistent with its tensors even
+    while another thread grows/syncs the table stack."""
+    from cilium_tpu.endpoint.tables import DeviceTableManager
+    from cilium_tpu.policy.mapstate import (INGRESS, PolicyKey,
+                                            PolicyMapState,
+                                            PolicyMapStateEntry)
+    mgr = DeviceTableManager(initial_endpoints=2, initial_slots=8)
+    for ep in range(2):
+        mgr.attach(ep)
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        ident = 256
+        while not stop.is_set():
+            st = PolicyMapState()
+            for _ in range(20):
+                st[PolicyKey(identity=ident, dest_port=ident % 60000,
+                             nexthdr=6, direction=INGRESS)] = \
+                    PolicyMapStateEntry()
+                ident += 1
+            try:
+                mgr.sync_endpoint(ident % 2, st, revision=ident)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            (capacity, slots, max_probe, _gen), (kid, kmeta, val) = \
+                mgr.snapshot()
+            assert kid.shape == (capacity, slots)
+            assert kmeta.shape == (capacity, slots)
+            assert val.shape == (capacity, slots)
+            assert max_probe >= 1
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors
+
+
+def test_refresh_policy_uses_snapshot_geometry():
+    """refresh_policy must jit/install from one consistent snapshot; a
+    grow between geometry read and tensor fetch used to install
+    reshaped tensors under a stale step."""
+    from cilium_tpu.datapath.engine import Datapath, make_full_batch
+    from cilium_tpu.endpoint.tables import DeviceTableManager
+    from cilium_tpu.policy.mapstate import (EGRESS, PolicyKey,
+                                            PolicyMapState,
+                                            PolicyMapStateEntry)
+    mgr = DeviceTableManager(initial_endpoints=2, initial_slots=8)
+    mgr.attach(0)
+    dp = Datapath(ct_slots=64, ct_probe=4)
+    dp.use_table_manager(mgr, ipcache_prefixes={"10.0.0.0/8": 300})
+    st = PolicyMapState()
+    st[PolicyKey(identity=300, dest_port=80, nexthdr=6,
+                 direction=EGRESS)] = PolicyMapStateEntry()
+    mgr.sync_endpoint(0, st, revision=1)
+    assert dp.refresh_policy(revision=1) in (True, False)
+    batch = make_full_batch(endpoint=[0], saddr=["10.1.1.1"],
+                            daddr=["10.0.0.5"], sport=[1234], dport=[80],
+                            direction=[1])
+    verdict, _ev, _ident, _nat = dp.process(batch, now=1000)
+    assert int(np.asarray(verdict)[0]) >= 0  # allowed
+    # force a grow (more entries than slots allow) and refresh again
+    big = PolicyMapState()
+    for i in range(300):
+        big[PolicyKey(identity=300 + i, dest_port=80, nexthdr=6,
+                      direction=EGRESS)] = PolicyMapStateEntry()
+    mgr.sync_endpoint(0, big, revision=2)
+    assert dp.refresh_policy(revision=2) is True  # re-jit on geometry
+    verdict, _ev, _ident, _nat = dp.process(batch, now=1001)
+    assert int(np.asarray(verdict)[0]) >= 0
+
+
+# ------------------------------------ ToServices translation per-service
+
+def test_translate_preserves_other_services_cidrs():
+    from cilium_tpu.k8s import translate_to_services
+    from cilium_tpu.policy.api import (EgressRule, EndpointSelector,
+                                       K8sServiceNamespace, Rule, Service)
+    rule = Rule(
+        endpoint_selector=EndpointSelector.parse("app=x"),
+        egress=[EgressRule(to_services=[
+            Service(k8s_service=K8sServiceNamespace(
+                service_name="a", namespace="prod")),
+            Service(k8s_service=K8sServiceNamespace(
+                service_name="b", namespace="prod"))])])
+    translate_to_services([rule], "a", "prod", ["10.0.0.1"])
+    translate_to_services([rule], "b", "prod", ["10.0.1.1"])
+    cidrs = sorted(c.cidr for c in rule.egress[0].to_cidr_set)
+    assert cidrs == ["10.0.0.1/32", "10.0.1.1/32"]
+    # service a's backends change: b's generated entry must survive
+    translate_to_services([rule], "a", "prod", ["10.0.0.2"],
+                          old_backend_ips=["10.0.0.1"])
+    cidrs = sorted(c.cidr for c in rule.egress[0].to_cidr_set)
+    assert cidrs == ["10.0.0.2/32", "10.0.1.1/32"]
+    # a scales to zero: only a's entry removed
+    translate_to_services([rule], "a", "prod", [],
+                          old_backend_ips=["10.0.0.2"])
+    cidrs = [c.cidr for c in rule.egress[0].to_cidr_set]
+    assert cidrs == ["10.0.1.1/32"]
+
+
+def test_watcher_endpoints_event_keeps_sibling_service():
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.k8s import K8sWatcher
+    from cilium_tpu.policy.api import (EgressRule, EndpointSelector,
+                                       K8sServiceNamespace, Rule, Service)
+    from cilium_tpu.utils.option import DaemonConfig
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        rule = Rule(
+            endpoint_selector=EndpointSelector.parse("app=x"),
+            egress=[EgressRule(to_services=[
+                Service(k8s_service=K8sServiceNamespace(
+                    service_name="a", namespace="ns")),
+                Service(k8s_service=K8sServiceNamespace(
+                    service_name="b", namespace="ns"))])])
+        d.policy_add([rule])
+
+        def ep_obj(name, ips):
+            return {"metadata": {"name": name, "namespace": "ns"},
+                    "subsets": [{"addresses": [{"ip": ip} for ip in ips]}]}
+
+        w.on_endpoints("added", ep_obj("a", ["10.8.0.1"]))
+        w.on_endpoints("added", ep_obj("b", ["10.8.1.1"]))
+        # an Endpoints update for a must not wipe b's backends
+        w.on_endpoints("modified", ep_obj("a", ["10.8.0.2"]))
+        live = d.repo.rules[0]
+        cidrs = sorted(c.cidr for c in live.egress[0].to_cidr_set)
+        assert cidrs == ["10.8.0.2/32", "10.8.1.1/32"]
+    finally:
+        d.shutdown()

@@ -119,8 +119,11 @@ def test_translate_to_services():
     cidrs = [c.cidr for c in rule.egress[0].to_cidr_set]
     assert cidrs == ["10.0.0.5/32", "10.0.0.6/32"]
     assert all(c.generated for c in rule.egress[0].to_cidr_set)
-    # re-translation replaces, not appends
-    translate_to_services([rule], "db", "prod", ["10.0.0.7"])
+    # re-translation replaces this service's entries, not appends
+    # (rule_translate.go: delete only generated CIDRs containing the
+    # service's old endpoint IPs, then add the new backends)
+    translate_to_services([rule], "db", "prod", ["10.0.0.7"],
+                          old_backend_ips=["10.0.0.5", "10.0.0.6"])
     assert [c.cidr for c in rule.egress[0].to_cidr_set] == ["10.0.0.7/32"]
     # other services untouched
     assert translate_to_services([rule], "other", "prod", ["1.2.3.4"]) == 0
