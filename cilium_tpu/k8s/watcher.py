@@ -109,6 +109,16 @@ class K8sWatcher:
             self._endpoints[key] = ips
             touched = translate_to_services(rules, key[1], key[0], ips,
                                             old_backend_ips=old_ips)
+            if touched:
+                # Heal shared backends: when two services select the
+                # same pod IP, removing this service's old CIDRs also
+                # removed the sibling's (ownership can't be inferred
+                # from IP containment alone).  Re-translating every
+                # other known service re-adds anything it still owns —
+                # idempotent, since translate replaces-in-place.
+                for (ns, svc), sips in self._endpoints.items():
+                    if (ns, svc) != key:
+                        translate_to_services(rules, svc, ns, sips)
         if touched:
             # the new backend /32s need CIDR identities + ipcache
             # entries before the regenerated policy can match them

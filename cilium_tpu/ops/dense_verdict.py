@@ -15,11 +15,13 @@ Semantics are identical to the 3-stage fallback of
 bpf/lib/policy.h:46 __policy_can_access; parity with the hash engine
 and the scalar oracle is enforced by tests.
 
-The Pallas kernel runs a 1-D grid over packet blocks with the entry
-arrays fully VMEM-resident (N <= MAX_PALLAS_ENTRIES); counters
-accumulate in a block that stays in VMEM across grid steps. On CPU it
-runs in interpret mode. Larger rule sets use the jnp path (XLA tiles
-the same compare) or the hash engine.
+The Pallas kernel runs a 2-D grid (packet blocks x entry tiles): the
+entry axis streams through VMEM in TILE_N tiles while per-packet stage
+accumulators stay VMEM-resident across the inner tile loop, so there is
+no entry-count cap.  On CPU it runs in interpret mode.  Note the
+compare is still O(B*N): at very large N (millions of entries) the
+constant-probe bucket engine (ops/bucket_ops.py) is the right tool —
+dense wins on small-to-mid rule sets where gathers dominate.
 """
 
 from __future__ import annotations
@@ -45,8 +47,11 @@ VERDICT_DROP = -1
 
 # Entry axis padded to the TPU lane width.
 LANE = 128
-# Entries must fit VMEM alongside the [block_b, N] compare matrices.
-MAX_PALLAS_ENTRIES = 2048
+# Per-grid-step entry tile: [block_b, TILE_N] compare matrices must fit
+# VMEM (~16 MB/core); 256x2048 int32 = 2 MB per live matrix.  The entry
+# axis itself is unbounded — the kernel walks it in tiles (2-D grid),
+# carrying per-packet stage accumulators in VMEM-resident output blocks.
+TILE_N = 2048
 
 
 class DenseTables(NamedTuple):
@@ -147,73 +152,137 @@ def dense_verdict_step(tables: DenseTables, counters_packets: jnp.ndarray,
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
-def _dense_kernel(ep_ref, ka_ref, kb_ref, val_ref, pep_ref, pid_ref,
-                  pme_ref, pml_ref, plen_ref, verdict_ref, cpk_ref,
-                  cby_ref):
-    """One packet-block grid step; entries fully resident.
+def _dense_tiled_kernel(ep_ref, ka_ref, kb_ref, val_ref, pep_ref, pid_ref,
+                        pme_ref, pml_ref, h1_ref, v1_ref, i1_ref, h2_ref,
+                        i2_ref, h3_ref, v3_ref, i3_ref, *, tile_n: int):
+    """Grid step (i: packet block, j: entry tile; j fastest).
 
-    Outputs: verdict row block (1, block_b), counter blocks (1, N) that
-    map to the same block every step (stay in VMEM, accumulate)."""
-    verdict, d_pk, d_by = _classify_block(
-        ep_ref[0, :], ka_ref[0, :], kb_ref[0, :], val_ref[0, :],
-        pep_ref[0, :], pid_ref[0, :], pme_ref[0, :], pml_ref[0, :],
-        plen_ref[0, :])
-    verdict_ref[0, :] = verdict
+    Accumulates per-packet stage partials across entry tiles in the
+    eight output blocks, which map to the same (0, i) block for every j
+    — they stay VMEM-resident and survive across the inner j loop.
+    Unique keys per endpoint mean at most ONE entry matches per stage
+    across ALL tiles, so sums both accumulate and select.  Entry
+    indices are stored +1 so 0 means "no match" (entry 0 is real).
+    """
+    j = pl.program_id(1)
+    ep = ep_ref[0, :]
+    ka = ka_ref[0, :]
+    kb = kb_ref[0, :]
+    val = val_ref[0, :]
+    pep = pep_ref[0, :]
+    pid = pid_ref[0, :]
+    pme = pme_ref[0, :]
+    pml = pml_ref[0, :]
 
-    @pl.when(pl.program_id(0) == 0)
+    same_ep = pep[:, None] == ep[None, :]
+    ident_eq = pid[:, None] == ka[None, :]
+    m1 = same_ep & ident_eq & (pme[:, None] == kb[None, :])
+    m2 = same_ep & ident_eq & (pml[:, None] == kb[None, :])
+    m3 = same_ep & (ka[None, :] == 0) & (pme[:, None] == kb[None, :])
+    i1 = m1.astype(jnp.int32)
+    i2 = m2.astype(jnp.int32)
+    i3 = m3.astype(jnp.int32)
+    # global entry index of this tile's columns, +1 (0 = no match)
+    gidx = (j * tile_n +
+            jax.lax.broadcasted_iota(jnp.int32, m1.shape, 1) + 1)
+
+    d_h1 = i1.sum(axis=1)
+    d_v1 = (i1 * val[None, :]).sum(axis=1)
+    d_i1 = (i1 * gidx).sum(axis=1)
+    d_h2 = i2.sum(axis=1)
+    d_i2 = (i2 * gidx).sum(axis=1)
+    d_h3 = i3.sum(axis=1)
+    d_v3 = (i3 * val[None, :]).sum(axis=1)
+    d_i3 = (i3 * gidx).sum(axis=1)
+
+    @pl.when(j == 0)
     def _zero():
-        cpk_ref[0, :] = jnp.zeros_like(d_pk)
-        cby_ref[0, :] = jnp.zeros_like(d_by)
+        for ref in (h1_ref, v1_ref, i1_ref, h2_ref, i2_ref, h3_ref,
+                    v3_ref, i3_ref):
+            ref[0, :] = jnp.zeros_like(d_h1)
 
-    cpk_ref[0, :] = cpk_ref[0, :] + d_pk
-    cby_ref[0, :] = cby_ref[0, :] + d_by
+    h1_ref[0, :] += d_h1
+    v1_ref[0, :] += d_v1
+    i1_ref[0, :] += d_i1
+    h2_ref[0, :] += d_h2
+    i2_ref[0, :] += d_i2
+    h3_ref[0, :] += d_h3
+    v3_ref[0, :] += d_v3
+    i3_ref[0, :] += d_i3
 
 
 def dense_verdict_pallas(tables: DenseTables, pkt_ep, pkt_ident,
                          pkt_dport, pkt_proto, pkt_dir, pkt_len,
-                         block_b: int = 256,
+                         block_b: int = 256, tile_n: int = TILE_N,
                          interpret: Optional[bool] = None):
-    """Pallas dense engine. Returns (verdict [B], counter deltas
-    (packets [N], bytes [N]) for this batch). Requires
-    N <= MAX_PALLAS_ENTRIES and B % block_b == 0."""
+    """Pallas dense engine, entry axis tiled through VMEM.
+
+    Returns (verdict [B], counter deltas (packets [N], bytes [N])).
+    No entry-count cap: the grid walks ceil(N / tile_n) tiles per
+    packet block.  Requires B % block_b == 0.
+    """
     if not HAS_PALLAS:
         raise RuntimeError("pallas unavailable")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n = tables.ep.shape[0]
     b = pkt_ep.shape[0]
-    if n > MAX_PALLAS_ENTRIES:
-        raise ValueError(
-            f"{n} entries > MAX_PALLAS_ENTRIES={MAX_PALLAS_ENTRIES}; "
-            f"use dense_verdict_step or the hash engine")
     block_b = min(block_b, b)
     if b % block_b:
         raise ValueError(f"batch {b} not divisible by block {block_b}")
+    tile_n = min(tile_n, max(LANE, n))
+    pad = (-n) % tile_n
+    ep_t, ka_t, kb_t, val_t = tables
+    if pad:
+        ep_t = jnp.concatenate(
+            [ep_t, jnp.full(pad, -1, jnp.int32)])  # never matches
+        zeros = jnp.zeros(pad, jnp.int32)
+        ka_t = jnp.concatenate([ka_t, zeros])
+        kb_t = jnp.concatenate([kb_t, zeros])
+        val_t = jnp.concatenate([val_t, zeros])
+    n_pad = n + pad
+    n_tiles = n_pad // tile_n
 
     meta_exact = _meta(pkt_dport, pkt_proto, pkt_dir)
     meta_l3 = _meta(jnp.zeros_like(pkt_dport), jnp.zeros_like(pkt_proto),
                     pkt_dir)
     row = lambda x: x.reshape(1, -1)
-    entry_spec = lambda: pl.BlockSpec((1, n), lambda i: (0, 0))
-    pkt_spec = lambda: pl.BlockSpec((1, block_b), lambda i: (0, i))
+    entry_spec = lambda: pl.BlockSpec((1, tile_n), lambda i, j: (0, j))
+    pkt_spec = lambda: pl.BlockSpec((1, block_b), lambda i, j: (0, i))
+    acc_spec = lambda: pl.BlockSpec((1, block_b), lambda i, j: (0, i))
+    acc_shape = lambda: jax.ShapeDtypeStruct((1, b), jnp.int32)
 
-    verdict, cpk, cby = pl.pallas_call(
-        _dense_kernel,
-        grid=(b // block_b,),
+    (h1, v1, i1, h2, i2, h3, v3, i3) = pl.pallas_call(
+        functools.partial(_dense_tiled_kernel, tile_n=tile_n),
+        grid=(b // block_b, n_tiles),
         in_specs=[entry_spec(), entry_spec(), entry_spec(), entry_spec(),
-                  pkt_spec(), pkt_spec(), pkt_spec(), pkt_spec(),
-                  pkt_spec()],
-        out_specs=[pl.BlockSpec((1, block_b), lambda i: (0, i)),
-                   pl.BlockSpec((1, n), lambda i: (0, 0)),
-                   pl.BlockSpec((1, n), lambda i: (0, 0))],
-        out_shape=[jax.ShapeDtypeStruct((1, b), jnp.int32),
-                   jax.ShapeDtypeStruct((1, n), jnp.int32),
-                   jax.ShapeDtypeStruct((1, n), jnp.int32)],
+                  pkt_spec(), pkt_spec(), pkt_spec(), pkt_spec()],
+        out_specs=[acc_spec() for _ in range(8)],
+        out_shape=[acc_shape() for _ in range(8)],
         interpret=interpret,
-    )(row(tables.ep), row(tables.key_a), row(tables.key_b),
-      row(tables.value), row(pkt_ep), row(pkt_ident), row(meta_exact),
-      row(meta_l3), row(pkt_len))
-    return verdict[0], cpk[0], cby[0]
+    )(row(ep_t), row(ka_t), row(kb_t), row(val_t), row(pkt_ep),
+      row(pkt_ident), row(meta_exact), row(meta_l3))
+    h1, v1, i1, h2, i2, h3, v3, i3 = (x[0] for x in
+                                      (h1, v1, i1, h2, i2, h3, v3, i3))
+    hit1 = h1 > 0
+    hit2 = h2 > 0
+    hit3 = h3 > 0
+    verdict = jnp.where(
+        hit1, v1,
+        jnp.where(hit2, jnp.int32(0),
+                  jnp.where(hit3, v3, jnp.int32(VERDICT_DROP))))
+    # counter scatter outside the kernel: each decided packet
+    # increments its deciding entry (same m_eff semantics as the jnp
+    # path); misses scatter weight 0 into entry 0
+    win = jnp.where(hit1, i1, jnp.where(hit2, i2,
+                                        jnp.where(hit3, i3, 0)))
+    decided = win > 0
+    idx = jnp.maximum(win - 1, 0)
+    inc = decided.astype(jnp.int32)
+    d_pk = jnp.zeros(n, jnp.int32).at[idx].add(inc)
+    d_by = jnp.zeros(n, jnp.int32).at[idx].add(
+        inc * pkt_len.astype(jnp.int32))
+    return verdict, d_pk, d_by
 
 
 # ---------------------------------------------------------------------------
@@ -287,8 +356,9 @@ class DenseVerdictEngine:
                  use_pallas: bool = False, block_b: int = 256):
         self.tables = compile_dense(map_states)
         n = self.tables.ep.shape[0]
-        self.use_pallas = (use_pallas and HAS_PALLAS and
-                           n <= MAX_PALLAS_ENTRIES)
+        # the tiled kernel has no entry cap (entry axis walks VMEM in
+        # TILE_N tiles), so pallas is available at any N
+        self.use_pallas = use_pallas and HAS_PALLAS
         self.block_b = block_b
         self.counters_packets = jnp.zeros(n, jnp.uint32)
         self.counters_bytes = jnp.zeros(n, jnp.uint32)

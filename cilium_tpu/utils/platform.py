@@ -68,8 +68,14 @@ def main_with_fallback(run, timeout: float | None = None,
         run()
         return
 
-    timeout = float(os.environ.get("CILIUM_TPU_BENCH_TIMEOUT",
-                                   timeout if timeout is not None else 420))
+    default_timeout = timeout if timeout is not None else 420
+    try:
+        timeout = float(os.environ.get("CILIUM_TPU_BENCH_TIMEOUT",
+                                       default_timeout))
+    except ValueError:
+        # a malformed env override must not break the always-emit-JSON
+        # contract this wrapper exists for
+        timeout = float(default_timeout)
     # The image sets JAX_PLATFORMS=axon ambiently, so an accelerator
     # value is NOT a user override — keep the CPU fallback for it.
     # Only an explicit cpu request pins a single attempt.

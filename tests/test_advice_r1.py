@@ -233,3 +233,39 @@ def test_watcher_endpoints_event_keeps_sibling_service():
         assert cidrs == ["10.8.0.2/32", "10.8.1.1/32"]
     finally:
         d.shutdown()
+
+
+def test_shared_backend_ip_survives_sibling_scaledown():
+    """Two services selecting the same pod IP: one service scaling to
+    zero must not delete the IP while the other still owns it."""
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.k8s import K8sWatcher
+    from cilium_tpu.policy.api import (EgressRule, EndpointSelector,
+                                       K8sServiceNamespace, Rule, Service)
+    from cilium_tpu.utils.option import DaemonConfig
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        rule = Rule(
+            endpoint_selector=EndpointSelector.parse("app=x"),
+            egress=[EgressRule(to_services=[
+                Service(k8s_service=K8sServiceNamespace(
+                    service_name="a", namespace="ns")),
+                Service(k8s_service=K8sServiceNamespace(
+                    service_name="b", namespace="ns"))])])
+        d.policy_add([rule])
+
+        def ep_obj(name, ips):
+            return {"metadata": {"name": name, "namespace": "ns"},
+                    "subsets": [{"addresses": [{"ip": i} for i in ips]}]}
+
+        shared = "10.9.0.1"
+        w.on_endpoints("added", ep_obj("a", [shared]))
+        w.on_endpoints("added", ep_obj("b", [shared, "10.9.0.2"]))
+        # a scales to zero; b still selects the shared pod
+        w.on_endpoints("modified", ep_obj("a", []))
+        live = d.repo.rules[0]
+        cidrs = sorted(c.cidr for c in live.egress[0].to_cidr_set)
+        assert cidrs == ["10.9.0.1/32", "10.9.0.2/32"]
+    finally:
+        d.shutdown()
