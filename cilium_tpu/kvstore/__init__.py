@@ -1,15 +1,19 @@
 """Distributed control-plane key-value store.
 
-TPU-native recast of the reference's ``pkg/kvstore``: a backend interface
-(reference: pkg/kvstore/backend.go:86-146) carrying the three replicated
-stores (identities, ip->identity, nodes), with an in-process backend for
-tests/single-node operation (reference: pkg/kvstore/dummy.go) and the
-distributed ID-allocation protocol (reference: pkg/kvstore/allocator/).
+TPU-native recast of the reference's ``pkg/kvstore``: a backend
+interface (reference: pkg/kvstore/backend.go:86-146) carrying the three
+replicated stores (identities, ip->identity, nodes), with:
 
-An etcd backend slot exists behind the same interface; in this image no
-etcd client library is available so distribution across real hosts rides
-the in-process backend shared between components (a remote backend is a
-drop-in via ``register_backend``).
+- an in-process backend for tests/single-node operation (reference:
+  pkg/kvstore/dummy.go);
+- a TCP server + client pair (server.py / remote.py) with etcd-shaped
+  semantics — leases, CreateOnly/CreateIfExists, prefix watches,
+  distributed locks — so separate agent processes share one store over
+  a real socket (reference: pkg/kvstore/etcd.go);
+- the distributed ID-allocation protocol (reference:
+  pkg/kvstore/allocator/).
+
+Run a standalone store: ``python -m cilium_tpu.kvstore.serve [port]``.
 """
 
 from .backend import (EVENT_CREATE, EVENT_DELETE, EVENT_LIST_DONE,
@@ -17,9 +21,12 @@ from .backend import (EVENT_CREATE, EVENT_DELETE, EVENT_LIST_DONE,
                       close_client, get_client, register_backend,
                       setup_client, setup_dummy)
 from .memory import InMemoryBackend
+from .remote import RemoteBackend
+from .server import KVStoreServer
 
 __all__ = [
     "BackendOperations", "Event", "InMemoryBackend", "KVLockError",
+    "KVStoreServer", "RemoteBackend",
     "EVENT_CREATE", "EVENT_MODIFY", "EVENT_DELETE", "EVENT_LIST_DONE",
     "setup_client", "setup_dummy", "get_client", "close_client",
     "register_backend",

@@ -84,6 +84,20 @@ class InMemoryBackend(BackendOperations):
             self.store.sessions[self.session] = \
                 time.monotonic() + lease_ttl
 
+    def _lease_session(self) -> str:
+        """Session id for lease-backed writes, revived if reaped.
+
+        A client stalled past its TTL gets its session (and keys)
+        reaped; without revival its later keepalives would silently
+        no-op and new lease-backed keys would belong to a session id
+        absent from the sessions map — unreapable forever.  Assumes
+        store.mu is held.
+        """
+        if self.session not in self.store.sessions:
+            self.store.sessions[self.session] = \
+                time.monotonic() + self.lease_ttl
+        return self.session
+
     # -- plain ops ---------------------------------------------------------
     def get(self, key: str) -> Optional[bytes]:
         with self.store.mu:
@@ -103,7 +117,7 @@ class InMemoryBackend(BackendOperations):
         with self.store.mu:
             self.store.expire_sessions()
             self.store._put(key, value,
-                            self.session if lease else None)
+                            self._lease_session() if lease else None)
 
     def delete(self, key: str) -> None:
         with self.store.mu:
@@ -123,7 +137,8 @@ class InMemoryBackend(BackendOperations):
             self.store.expire_sessions()
             if key in self.store.data:
                 return False
-            self.store._put(key, value, self.session if lease else None)
+            self.store._put(key, value,
+                            self._lease_session() if lease else None)
             return True
 
     def create_if_exists(self, cond_key: str, key: str, value: bytes,
@@ -132,7 +147,8 @@ class InMemoryBackend(BackendOperations):
             self.store.expire_sessions()
             if cond_key not in self.store.data or key in self.store.data:
                 return False
-            self.store._put(key, value, self.session if lease else None)
+            self.store._put(key, value,
+                            self._lease_session() if lease else None)
             return True
 
     # -- listing / watching ------------------------------------------------
@@ -189,9 +205,11 @@ class InMemoryBackend(BackendOperations):
 
     def renew_lease(self) -> None:
         with self.store.mu:
-            if self.session in self.store.sessions:
-                self.store.sessions[self.session] = \
-                    time.monotonic() + self.lease_ttl
+            # revives a reaped session (see _lease_session): a client
+            # that stalled past its TTL must regain liveness rather
+            # than keep "renewing" a session that no longer exists
+            self.store.sessions[self._lease_session()] = \
+                time.monotonic() + self.lease_ttl
 
     def expire_now(self) -> None:
         """Test hook: this client's lease dies immediately (node failure)."""

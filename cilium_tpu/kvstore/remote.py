@@ -1,0 +1,239 @@
+"""TCP kvstore client: BackendOperations over a socket.
+
+The client half of kvstore/server.py — a drop-in backend for the
+Daemon, so two agent processes converge identities/ipcache/nodes
+through a real network transport (reference: pkg/kvstore/etcd.go's
+client role).  A background keepalive thread renews the session lease
+at ttl/3; if the process dies the lease lapses server-side and its
+lease-backed keys vanish.
+"""
+
+from __future__ import annotations
+
+import base64
+import socket
+import threading
+from typing import Dict, Optional
+
+from .backend import (EVENT_LIST_DONE, BackendOperations, Event,
+                      KVLockError, Lock, Watcher, register_backend)
+from .server import recv_frame, send_frame
+
+DEFAULT_TTL = 15.0
+
+
+class RemoteError(RuntimeError):
+    pass
+
+
+class RemoteBackend(BackendOperations):
+    name = "remote"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 42379,
+                 lease_ttl: float = DEFAULT_TTL,
+                 connect_timeout: float = 5.0):
+        self.host, self.port = host, int(port)
+        self.lease_ttl = lease_ttl
+        self._sock = socket.create_connection((host, self.port),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._mu = threading.Lock()
+        self._next_id = 0
+        self._pending: Dict[int, dict] = {}      # id -> {"ev", "resp"}
+        self._watchers: Dict[int, Watcher] = {}  # watch_id -> Watcher
+        self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True, name="kv-reader")
+        self._reader.start()
+        resp = self._call("hello", ttl=lease_ttl)
+        self.session = resp["session"]
+        self._keepalive = threading.Thread(target=self._keepalive_loop,
+                                           daemon=True,
+                                           name="kv-keepalive")
+        self._keepalive.start()
+
+    # --------------------------------------------------------- plumbing
+
+    def _read_loop(self):
+        while not self._closed.is_set():
+            try:
+                msg = recv_frame(self._sock)
+            except (OSError, ValueError):
+                msg = None
+            if msg is None:
+                break
+            if "watch_id" in msg:
+                with self._mu:
+                    watcher = self._watchers.get(int(msg["watch_id"]))
+                if watcher is not None:
+                    watcher._emit(Event(
+                        msg["typ"], msg.get("key", ""),
+                        base64.b64decode(msg.get("value_b64", ""))))
+                continue
+            with self._mu:
+                slot = self._pending.get(msg.get("id"))
+            if slot is not None:
+                slot["resp"] = msg
+                slot["ev"].set()
+        # connection lost: mark closed FIRST so no new _call can park a
+        # slot that nothing will ever complete, then fail everything
+        # pending and end watches
+        self._closed.set()
+        with self._mu:
+            pending = list(self._pending.values())
+            watchers = list(self._watchers.values())
+            self._pending.clear()
+            self._watchers.clear()
+        for slot in pending:
+            slot.setdefault("resp", {"ok": False,
+                                     "error": "connection lost"})
+            slot["ev"].set()
+        for watcher in watchers:
+            watcher._queue.put(None)
+
+    def _keepalive_loop(self):
+        interval = max(0.2, self.lease_ttl / 3.0)
+        while not self._closed.wait(interval):
+            try:
+                self._call("renew_lease")
+            except RemoteError:
+                return
+
+    def _call(self, op: str, _timeout: Optional[float] = None,
+              **args) -> dict:
+        if self._closed.is_set():
+            raise RemoteError("client closed")
+        with self._mu:
+            self._next_id += 1
+            rid = self._next_id
+            slot = {"ev": threading.Event()}
+            self._pending[rid] = slot
+        req = {"id": rid, "op": op}
+        req.update(args)
+        try:
+            send_frame(self._sock, req, self._wlock)
+        except OSError as e:
+            with self._mu:
+                self._pending.pop(rid, None)
+            raise RemoteError(f"send failed: {e}") from e
+        if not slot["ev"].wait(_timeout):
+            with self._mu:
+                self._pending.pop(rid, None)
+            raise RemoteError(f"{op}: timed out")
+        with self._mu:
+            self._pending.pop(rid, None)
+        resp = slot["resp"]
+        if not resp.get("ok"):
+            if resp.get("kind") == "lock":
+                raise KVLockError(resp.get("error", "lock failed"))
+            raise RemoteError(resp.get("error", "request failed"))
+        return resp
+
+    @staticmethod
+    def _b64(value: bytes) -> str:
+        return base64.b64encode(value).decode()
+
+    # -------------------------------------------------------- plain ops
+
+    def get(self, key: str) -> Optional[bytes]:
+        resp = self._call("get", key=key)
+        return None if resp.get("missing") else \
+            base64.b64decode(resp["value_b64"])
+
+    def get_prefix(self, prefix: str) -> Optional[bytes]:
+        resp = self._call("get_prefix", prefix=prefix)
+        return None if resp.get("missing") else \
+            base64.b64decode(resp["value_b64"])
+
+    def set(self, key: str, value: bytes, lease: bool = False) -> None:
+        self._call("set", key=key, value_b64=self._b64(value), lease=lease)
+
+    def delete(self, key: str) -> None:
+        self._call("delete", key=key)
+
+    def delete_prefix(self, prefix: str) -> None:
+        self._call("delete_prefix", prefix=prefix)
+
+    def create_only(self, key: str, value: bytes,
+                    lease: bool = False) -> bool:
+        return self._call("create_only", key=key,
+                          value_b64=self._b64(value),
+                          lease=lease)["created"]
+
+    def create_if_exists(self, cond_key: str, key: str, value: bytes,
+                         lease: bool = False) -> bool:
+        return self._call("create_if_exists", cond_key=cond_key, key=key,
+                          value_b64=self._b64(value),
+                          lease=lease)["created"]
+
+    # -------------------------------------------------- listing / watch
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        items = self._call("list_prefix", prefix=prefix)["items"]
+        return {k: base64.b64decode(v) for k, v in items.items()}
+
+    def _new_watch(self, op: str, prefix: str) -> Watcher:
+        watcher = Watcher(prefix, self)
+        with self._mu:
+            self._next_id += 1
+            watch_id = self._next_id
+            self._watchers[watch_id] = watcher
+        watcher._remote_id = watch_id
+        self._call(op, prefix=prefix, watch_id=watch_id)
+        return watcher
+
+    def watch(self, prefix: str) -> Watcher:
+        return self._new_watch("watch", prefix)
+
+    def list_and_watch(self, prefix: str) -> Watcher:
+        return self._new_watch("list_and_watch", prefix)
+
+    def _remove_watcher(self, watcher: Watcher) -> None:
+        watch_id = getattr(watcher, "_remote_id", None)
+        if watch_id is None:
+            return
+        with self._mu:
+            self._watchers.pop(watch_id, None)
+        if not self._closed.is_set():
+            try:
+                self._call("unwatch", watch_id=watch_id)
+            except (RemoteError, KVLockError):
+                pass
+
+    # --------------------------------------------------- locks / lease
+
+    def lock_path(self, path: str, timeout: float = 30.0) -> Lock:
+        # server enforces the acquisition timeout; our wait is padded
+        # so the grant/timeout response always arrives first
+        resp = self._call("lock", _timeout=timeout + 10.0, path=path,
+                          timeout=timeout)
+        return Lock(self, path, resp["lock_id"])
+
+    def _unlock(self, path: str, token: str) -> None:
+        try:
+            self._call("unlock", lock_id=token)
+        except RemoteError:
+            pass
+
+    def renew_lease(self) -> None:
+        self._call("renew_lease")
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    def status(self) -> str:
+        try:
+            return self._call("status", _timeout=2.0)["text"]
+        except (RemoteError, KVLockError):
+            return "remote: unreachable"
+
+
+register_backend(RemoteBackend.name, RemoteBackend)

@@ -1,0 +1,298 @@
+"""TCP kvstore server — the control plane's real network transport.
+
+Round 1's "distributed" control plane never crossed a process boundary:
+every agent shared one in-process MemStore.  This server puts the
+MemStore behind a socket with etcd-shaped semantics (reference:
+pkg/kvstore/etcd.go — leases, atomic CreateOnly/CreateIfExists, prefix
+watches, distributed locks), so separate agent *processes* share one
+store and the allocator/ipcache/node protocols run over the wire.
+
+Wire protocol: 4-byte big-endian length + JSON.
+  request : {"id": n, "op": "...", ...args}   (values base64)
+  response: {"id": n, "ok": bool, ...result}
+  event   : {"watch_id": w, "typ": ..., "key": ..., "value_b64": ...}
+
+Sessions are leases: each connection starts one with a TTL; the client
+keeps it alive with renew_lease.  A killed client (kill -9) stops
+renewing; when the TTL lapses the server reaps the session and its
+lease-backed keys vanish — watchers on other connections see the
+deletes (allocator.go:88-89 semantics).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import struct
+import threading
+import uuid
+from typing import Dict, Optional, Tuple
+
+from .backend import Event, KVLockError, Lock, Watcher
+from .memory import InMemoryBackend, MemStore
+
+DEFAULT_PORT = 42379  # etcd's 2379, out of the privileged/common range
+
+
+def send_frame(sock: socket.socket, obj: dict,
+               lock: Optional[threading.Lock] = None) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    frame = struct.pack(">I", len(data)) + data
+    if lock:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def recv_frame(sock: socket.socket) -> Optional[dict]:
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (length,) = struct.unpack(">I", hdr)
+    if length > (64 << 20):
+        raise ValueError(f"frame too large: {length}")
+    body = _recv_exact(sock, length)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _b64(value: bytes) -> str:
+    return base64.b64encode(value).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class _Conn(socketserver.BaseRequestHandler):
+    """One client connection: a session + its watches and locks."""
+
+    def setup(self):
+        self.server_obj: "KVStoreServer" = self.server.kv_server
+        self.store: MemStore = self.server_obj.store
+        self.wlock = threading.Lock()
+        # ops delegate to a per-connection InMemoryBackend session, so
+        # lease/CAS/lock semantics live in exactly one place
+        # (memory.py); this handler only does wire marshaling + watch
+        # forwarding
+        self.backend: Optional[InMemoryBackend] = None
+        # watch_id -> (Watcher, forwarder thread)
+        self.watches: Dict[int, Tuple[Watcher, threading.Thread]] = {}
+        # lock_id -> Lock handle
+        self.locks: Dict[str, Lock] = {}
+
+    def handle(self):
+        self.request.settimeout(None)
+        while True:
+            try:
+                req = recv_frame(self.request)
+            except (ValueError, OSError):
+                break
+            if req is None:
+                break
+            # thread-per-request: lock_path blocks, and the connection
+            # must stay responsive to keepalives while it waits
+            threading.Thread(target=self._dispatch, args=(req,),
+                             daemon=True).start()
+
+    def _dispatch(self, req: dict) -> None:
+        rid = req.get("id")
+        try:
+            result = self._handle_op(req)
+            resp = {"id": rid, "ok": True}
+            if result:
+                resp.update(result)
+        except KVLockError as e:
+            resp = {"id": rid, "ok": False, "error": str(e),
+                    "kind": "lock"}
+        except Exception as e:  # noqa: BLE001 — wire back, don't die
+            resp = {"id": rid, "ok": False, "error": repr(e)}
+        try:
+            send_frame(self.request, resp, self.wlock)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- ops
+
+    def _handle_op(self, req: dict) -> Optional[dict]:
+        op = req["op"]
+        if op == "hello":
+            self.backend = InMemoryBackend(
+                self.store, lease_ttl=float(req.get("ttl", 15.0)))
+            return {"session": self.backend.session}
+        be = self.backend
+        if be is None:
+            raise ValueError("hello required first")
+        if op == "renew_lease":
+            be.renew_lease()
+            return None
+        if op == "get":
+            v = be.get(req["key"])
+            return {"missing": True} if v is None else {"value_b64": _b64(v)}
+        if op == "get_prefix":
+            v = be.get_prefix(req["prefix"])
+            return {"missing": True} if v is None else {"value_b64": _b64(v)}
+        if op == "set":
+            be.set(req["key"], _unb64(req["value_b64"]),
+                   lease=bool(req.get("lease")))
+            return None
+        if op == "delete":
+            be.delete(req["key"])
+            return None
+        if op == "delete_prefix":
+            be.delete_prefix(req["prefix"])
+            return None
+        if op == "create_only":
+            return {"created": be.create_only(
+                req["key"], _unb64(req["value_b64"]),
+                lease=bool(req.get("lease")))}
+        if op == "create_if_exists":
+            return {"created": be.create_if_exists(
+                req["cond_key"], req["key"], _unb64(req["value_b64"]),
+                lease=bool(req.get("lease")))}
+        if op == "list_prefix":
+            return {"items": {k: _b64(v) for k, v in
+                              be.list_prefix(req["prefix"]).items()}}
+        if op in ("watch", "list_and_watch"):
+            return self._start_watch(req, initial=(op == "list_and_watch"))
+        if op == "unwatch":
+            self._stop_watch(req["watch_id"])
+            return None
+        if op == "lock":
+            lock = be.lock_path(req["path"],
+                                timeout=float(req.get("timeout", 30.0)))
+            lock_id = uuid.uuid4().hex
+            self.locks[lock_id] = lock
+            return {"lock_id": lock_id}
+        if op == "unlock":
+            held = self.locks.pop(req["lock_id"], None)
+            if held:
+                held.unlock()
+            return None
+        if op == "status":
+            return {"text": be.status().replace("in-memory", "remote", 1)}
+        raise ValueError(f"unknown op {op!r}")
+
+    # ----------------------------------------------------------- watches
+
+    def _start_watch(self, req: dict, initial: bool) -> dict:
+        watch_id = int(req["watch_id"])
+        prefix = req["prefix"]
+        watcher = Watcher(prefix, _WatchHost(self.store))
+        with self.store.mu:
+            if initial:
+                self.store.expire_sessions()
+                for key in sorted(self.store.data):
+                    if key.startswith(prefix):
+                        watcher._emit(Event("create", key,
+                                            self.store.data[key][0]))
+                watcher._emit(Event("list-done"))
+            self.store.watchers.append((prefix, watcher))
+
+        def forward():
+            for ev in watcher:
+                try:
+                    send_frame(self.request,
+                               {"watch_id": watch_id, "typ": ev.typ,
+                                "key": ev.key,
+                                "value_b64": _b64(ev.value)}, self.wlock)
+                except OSError:
+                    return
+
+        t = threading.Thread(target=forward, daemon=True)
+        t.start()
+        self.watches[watch_id] = (watcher, t)
+        return {}
+
+    def _stop_watch(self, watch_id: int) -> None:
+        entry = self.watches.pop(int(watch_id), None)
+        if entry:
+            entry[0].stop()
+
+    def finish(self):
+        for watch_id in list(self.watches):
+            self._stop_watch(watch_id)
+        # held locks die with the connection (eager release avoids a
+        # stuck allocator waiting a full TTL)
+        for lock in self.locks.values():
+            try:
+                lock.unlock()
+            except Exception:  # noqa: BLE001
+                pass
+        self.locks.clear()
+        # the backend is NOT closed here: its session lives until the
+        # TTL lapses, exactly like an etcd lease after the client
+        # vanishes (close() would expire the lease immediately)
+
+
+class _WatchHost:
+    """Adapter so server-side Watchers can detach from the MemStore."""
+
+    def __init__(self, store: MemStore):
+        self.store = store
+
+    def _remove_watcher(self, watcher: Watcher) -> None:
+        with self.store.mu:
+            self.store.watchers = [(p, w) for p, w in self.store.watchers
+                                   if w is not watcher]
+
+
+class _ThreadingTCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class KVStoreServer:
+    """The store + listener.  start() binds and serves in background."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 store: Optional[MemStore] = None,
+                 expire_interval: float = 0.2):
+        self.store = store if store is not None else MemStore()
+        self._tcp = _ThreadingTCP((host, port), _Conn)
+        self._tcp.kv_server = self
+        self.host, self.port = self._tcp.server_address
+        self._serve_thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True, name="kv-server")
+        self._expire_interval = expire_interval
+        self._stop = threading.Event()
+        self._expirer = threading.Thread(target=self._expire_loop,
+                                         daemon=True, name="kv-expirer")
+
+    def start(self) -> "KVStoreServer":
+        self._serve_thread.start()
+        self._expirer.start()
+        return self
+
+    def _expire_loop(self):
+        # leases must lapse even when no client issues requests —
+        # that's the whole point of detecting a kill -9'd agent
+        while not self._stop.wait(self._expire_interval):
+            with self.store.mu:
+                self.store.expire_sessions()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._tcp.shutdown()
+        self._tcp.server_close()

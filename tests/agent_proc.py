@@ -1,0 +1,74 @@
+"""Subprocess agent for cross-process kvstore tests.
+
+Spawned by tests/test_remote_kvstore.py: connects a full Daemon to the
+TCP kvstore server, creates endpoints (allocating distributed
+identities over the wire), reports state as one JSON line on stdout,
+then either exits or sleeps until killed (kill -9 models node death:
+the lease stops renewing and the server reaps the session).
+
+Usage: python tests/agent_proc.py <port> <node_name> <mode> <ttl>
+  mode "report": allocate, print, clean shutdown
+  mode "sleep":  allocate, print, then sleep forever (parent kills -9)
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from cilium_tpu.daemon import Daemon  # noqa: E402
+from cilium_tpu.kvstore.remote import RemoteBackend  # noqa: E402
+from cilium_tpu.utils.option import DaemonConfig  # noqa: E402
+
+
+def main() -> None:
+    port = int(sys.argv[1])
+    node = sys.argv[2]
+    mode = sys.argv[3]
+    ttl = float(sys.argv[4]) if len(sys.argv) > 4 else 2.0
+
+    kv = RemoteBackend(port=port, lease_ttl=ttl)
+    d = Daemon(config=DaemonConfig(), kvstore_backend=kv, node_name=node)
+    try:
+        # two endpoints: one with cluster-shared labels, one node-unique
+        ep_shared = d.endpoint_create(
+            1, ipv4=f"10.50.{1 if node.endswith('a') else 2}.1",
+            labels=["k8s:app=shared-web"])
+        ep_unique = d.endpoint_create(
+            2, ipv4=f"10.50.{1 if node.endswith('a') else 2}.2",
+            labels=[f"k8s:app=only-{node}"])
+        # identity allocation is synchronous in endpoint_create;
+        # give ipcache kvstore sync a beat, then read the cluster view
+        deadline = time.time() + 10.0
+        want = {"10.50.1.1", "10.50.2.1"}
+        view = {}
+        while time.time() < deadline:
+            view = {ip: d.ipcache.lookup_by_ip(ip) for ip in want}
+            if all(v is not None for v in view.values()):
+                break
+            time.sleep(0.1)
+        print(json.dumps({
+            "node": node,
+            "shared_identity": ep_shared.security_identity,
+            "unique_identity": ep_unique.security_identity,
+            "ipcache": {ip: view.get(ip) for ip in sorted(want)},
+            "kv_status": kv.status(),
+        }), flush=True)
+        if mode == "sleep":
+            time.sleep(3600)
+    finally:
+        if mode != "sleep":
+            d.shutdown()
+            kv.close()
+
+
+if __name__ == "__main__":
+    main()
