@@ -1,0 +1,537 @@
+"""Socket-level L7 proxy data plane.
+
+The round-1 gap this closes: redirects existed only as in-process
+engine dispatch on pre-parsed requests.  This module is the real data
+plane — a transparent TCP proxy (asyncio in a background thread) that
+listens on each redirect's allocated proxy port, connects to the
+original destination (resolved via the proxymap analog), and pumps
+bytes BOTH directions through the policy machinery:
+
+- generic parser protocols (cassandra/memcached/line/block/...) drive
+  the proxylib-contract parser framework (l7/parser.py on_data:
+  PASS/DROP/MORE/INJECT/ERROR) over the live stream, with deny frames
+  injected back to the client in-protocol;
+- kafka gets a dedicated handler mirroring the reference's in-agent Go
+  proxy (pkg/proxy/kafka.go:454): per-request ACL checks, synthesized
+  typed error responses, and a correlation cache matching responses to
+  forwarded requests (pkg/kafka/correlation_cache.go:97) for
+  response-path access logging;
+- http/1.1 requests are framed (request line + headers +
+  Content-Length body), checked against the redirect's HTTPPolicyEngine,
+  denied with a 403 in-protocol; responses pass through.
+
+Every request is access-logged through the ProxyManager's AccessLog
+(pkg/proxy/logger analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .http import HTTPRequest
+from .kafka import (KafkaParseError, KafkaRequest, parse_kafka_request)
+from .parser import Connection as ParserConnection
+from .parser import Op, REGISTRY, ParserRegistry
+
+# Kafka error code injected on deny (reference: pkg/kafka/error-codes).
+TOPIC_AUTHORIZATION_FAILED = 29
+
+PRODUCE, FETCH, METADATA = 0, 1, 3
+
+
+# --------------------------------------------------------------------------
+# Kafka response correlation (pkg/kafka/correlation_cache.go:97)
+
+@dataclass
+class CorrelationEntry:
+    correlation_id: int
+    api_key: int
+    api_version: int
+    topics: List[str]
+    sent_at: float
+
+
+class CorrelationCache:
+    """Outstanding forwarded requests, matched to responses by
+    correlation id so the response path can be attributed and logged."""
+
+    def __init__(self, capacity: int = 4096):
+        self._entries: Dict[int, CorrelationEntry] = {}
+        self.capacity = capacity
+        self.overflows = 0
+
+    def put(self, req: KafkaRequest) -> None:
+        if len(self._entries) >= self.capacity:
+            # drop the oldest (the reference expires by correlation
+            # window); overflow counted for observability
+            oldest = min(self._entries, default=None,
+                         key=lambda k: self._entries[k].sent_at)
+            if oldest is not None:
+                del self._entries[oldest]
+                self.overflows += 1
+        self._entries[req.correlation_id] = CorrelationEntry(
+            correlation_id=req.correlation_id, api_key=req.api_key,
+            api_version=req.api_version, topics=list(req.topics),
+            sent_at=time.time())
+
+    def correlate(self, correlation_id: int) -> Optional[CorrelationEntry]:
+        return self._entries.pop(correlation_id, None)
+
+    def __len__(self):
+        return len(self._entries)
+
+
+def kafka_deny_response(req: KafkaRequest) -> bytes:
+    """Typed in-protocol error response for a denied request
+    (reference: kafka.go createProduceResponse etc. via sarama)."""
+    corr = struct.pack(">i", req.correlation_id)
+    topics = req.topics or [""]
+    if req.api_key == PRODUCE:
+        body = struct.pack(">i", len(topics))
+        for t in topics:
+            tb = t.encode()
+            body += struct.pack(">h", len(tb)) + tb
+            #   partitions: [ {partition=0, error=29, offset=-1} ]
+            body += struct.pack(">i", 1) + struct.pack(
+                ">ihq", 0, TOPIC_AUTHORIZATION_FAILED, -1)
+        if req.api_version >= 1:
+            body += struct.pack(">i", 0)  # throttle_time_ms
+    elif req.api_key == FETCH:
+        body = b""
+        if req.api_version >= 1:
+            body += struct.pack(">i", 0)  # throttle_time_ms
+        body += struct.pack(">i", len(topics))
+        for t in topics:
+            tb = t.encode()
+            body += struct.pack(">h", len(tb)) + tb
+            #   partitions: [ {partition=0, error=29, hw=-1, empty set} ]
+            body += struct.pack(">i", 1) + struct.pack(
+                ">ihqi", 0, TOPIC_AUTHORIZATION_FAILED, -1, 0)
+    elif req.api_key == METADATA:
+        body = struct.pack(">i", 0)  # brokers: []
+        body += struct.pack(">i", len(topics))
+        for t in topics:
+            tb = t.encode()
+            #   topic_metadata: {error=29, topic, partitions: []}
+            body += struct.pack(">h", TOPIC_AUTHORIZATION_FAILED)
+            body += struct.pack(">h", len(tb)) + tb
+            body += struct.pack(">i", 0)
+    else:
+        body = struct.pack(">h", TOPIC_AUTHORIZATION_FAILED)
+    payload = corr + body
+    return struct.pack(">i", len(payload)) + payload
+
+
+HTTP_DENY = (b"HTTP/1.1 403 Forbidden\r\n"
+             b"content-length: 15\r\n"
+             b"content-type: text/plain\r\n"
+             b"connection: close\r\n\r\n"
+             b"Access denied\r\n")
+
+
+# --------------------------------------------------------------------------
+
+@dataclass
+class ListenerContext:
+    """Everything a live listener needs per connection.
+
+    orig_dst: the proxymap analog — maps the accepted client address to
+    the flow's original (pre-redirect) destination.
+    identities/rules resolve the remote peer for policy + logging.
+    """
+
+    redirect_id: str
+    parser_type: str
+    orig_dst: Callable[[Tuple[str, int]], Tuple[str, int]]
+    l7_rules: Callable[[Tuple[str, int]], list] = lambda addr: []
+    identities: Callable[[Tuple[str, int]], Tuple[int, int]] = \
+        lambda addr: (0, 0)
+    http_engine_for: Optional[Callable[[Tuple[str, int]], object]] = None
+    kafka_engine_for: Optional[Callable[[Tuple[str, int]], object]] = None
+
+
+class SocketProxy:
+    """Owns the event loop + one TCP listener per active redirect."""
+
+    def __init__(self, access_log=None, registry: ParserRegistry = REGISTRY,
+                 host: str = "127.0.0.1"):
+        self.host = host
+        self.registry = registry
+        self.access_log = access_log
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="socket-proxy")
+        self._thread.start()
+        self._servers: Dict[str, asyncio.AbstractServer] = {}
+        self._next_conn_id = 0
+        self._lock = threading.Lock()
+        self.correlation = CorrelationCache()
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_forever()
+
+    def _submit(self, coro, timeout=10.0):
+        return asyncio.run_coroutine_threadsafe(
+            coro, self._loop).result(timeout)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start_listener(self, port: int, ctx: ListenerContext) -> int:
+        """Bind the redirect's proxy port; returns the bound port."""
+        async def _start():
+            server = await asyncio.start_server(
+                lambda r, w: self._handle(r, w, ctx),
+                host=self.host, port=port)
+            self._servers[ctx.redirect_id] = server
+            return server.sockets[0].getsockname()[1]
+        return self._submit(_start())
+
+    def stop_listener(self, redirect_id: str) -> None:
+        async def _stop():
+            server = self._servers.pop(redirect_id, None)
+            if server is not None:
+                server.close()
+                await server.wait_closed()
+        self._submit(_stop())
+
+    def shutdown(self) -> None:
+        for rid in list(self._servers):
+            try:
+                self.stop_listener(rid)
+            except Exception:  # noqa: BLE001
+                pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+    def _log(self, ctx: ListenerContext, verdict: str, proto: str,
+             src_id: int, dst_id: int, info: dict) -> None:
+        if self.access_log is None:
+            return
+        from ..proxy import AccessLogEntry
+        self.access_log.log(AccessLogEntry(
+            timestamp=time.time(), proxy_id=ctx.redirect_id,
+            l7_protocol=proto, verdict=verdict, src_identity=src_id,
+            dst_identity=dst_id, info=info))
+
+    # -------------------------------------------------------- connection
+
+    async def _handle(self, client_r: asyncio.StreamReader,
+                      client_w: asyncio.StreamWriter,
+                      ctx: ListenerContext) -> None:
+        peer = client_w.get_extra_info("peername") or ("", 0)
+        try:
+            upstream_host, upstream_port = ctx.orig_dst(peer)
+            up_r, up_w = await asyncio.open_connection(upstream_host,
+                                                       upstream_port)
+        except Exception:  # noqa: BLE001 — no orig dst / upstream down
+            client_w.close()
+            return
+        src_id, dst_id = ctx.identities(peer)
+        try:
+            if ctx.parser_type == "kafka":
+                await self._pump_kafka(client_r, client_w, up_r, up_w,
+                                       ctx, peer, src_id, dst_id)
+            elif ctx.parser_type == "http":
+                await self._pump_http(client_r, client_w, up_r, up_w,
+                                      ctx, peer, src_id, dst_id)
+            else:
+                await self._pump_parser(client_r, client_w, up_r, up_w,
+                                        ctx, peer, src_id, dst_id)
+        finally:
+            for w in (client_w, up_w):
+                try:
+                    w.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # ------------------------------------------- generic parser protocols
+
+    async def _pump_parser(self, client_r, client_w, up_r, up_w, ctx,
+                           peer, src_id, dst_id):
+        factory = self.registry.get(ctx.parser_type)
+        if factory is None:
+            return
+        with self._lock:
+            self._next_conn_id += 1
+            conn_id = self._next_conn_id
+        conn = ParserConnection(
+            conn_id=conn_id, proto=ctx.parser_type, ingress=True,
+            src_identity=src_id, dst_identity=dst_id,
+            l7_rules=list(ctx.l7_rules(peer)))
+        parser = factory(conn)
+
+        async def request_path():
+            buf = b""
+            eof = False
+            while not eof or buf:
+                if not eof:
+                    chunk = await client_r.read(65536)
+                    if chunk:
+                        buf += chunk
+                    else:
+                        eof = True
+                progress = True
+                while buf and progress:
+                    progress = False
+                    ops = parser.on_data(False, eof, buf)
+                    for op in ops:
+                        if op.op == Op.PASS:
+                            up_w.write(buf[:op.n])
+                            buf = buf[op.n:]
+                            progress = True
+                            self._log(ctx, "forwarded", ctx.parser_type,
+                                      src_id, dst_id, {"bytes": op.n})
+                        elif op.op == Op.DROP:
+                            buf = buf[op.n:]
+                            progress = True
+                            self._log(ctx, "denied", ctx.parser_type,
+                                      src_id, dst_id, {"bytes": op.n})
+                        elif op.op == Op.INJECT:
+                            client_w.write(op.data)
+                            await client_w.drain()
+                        elif op.op == Op.MORE:
+                            break
+                        elif op.op == Op.ERROR:
+                            raise ConnectionResetError("parser error")
+                    await up_w.drain()
+                    if eof and not progress:
+                        buf = b""  # trailing bytes already judged
+            try:
+                up_w.write_eof()
+            except OSError:
+                pass
+
+        async def reply_path():
+            buf = b""
+            eof = False
+            while not eof or buf:
+                if not eof:
+                    chunk = await up_r.read(65536)
+                    if chunk:
+                        buf += chunk
+                    else:
+                        eof = True
+                progress = True
+                while buf and progress:
+                    progress = False
+                    ops = parser.on_data(True, eof, buf)
+                    for op in ops:
+                        if op.op == Op.PASS:
+                            client_w.write(buf[:op.n])
+                            buf = buf[op.n:]
+                            progress = True
+                        elif op.op == Op.DROP:
+                            buf = buf[op.n:]
+                            progress = True
+                        elif op.op == Op.INJECT:
+                            up_w.write(op.data)
+                            await up_w.drain()
+                        elif op.op == Op.MORE:
+                            break
+                        elif op.op == Op.ERROR:
+                            raise ConnectionResetError("parser error")
+                    await client_w.drain()
+                    if eof and not progress:
+                        buf = b""
+            try:
+                client_w.write_eof()
+            except OSError:
+                pass
+
+        await _run_both(request_path(), reply_path())
+
+    # ----------------------------------------------------------- kafka
+
+    async def _pump_kafka(self, client_r, client_w, up_r, up_w, ctx,
+                          peer, src_id, dst_id):
+        engine = ctx.kafka_engine_for(peer) if ctx.kafka_engine_for \
+            else None
+
+        async def request_path():
+            buf = b""
+            while True:
+                frame, buf = await _read_kafka_frame(client_r, buf)
+                if frame is None:
+                    break
+                try:
+                    req = parse_kafka_request(frame)
+                except KafkaParseError:
+                    # unparseable: fail closed when rules exist
+                    if engine is not None and engine.rules:
+                        raise ConnectionResetError("bad kafka frame")
+                    up_w.write(frame)
+                    await up_w.drain()
+                    continue
+                allowed = engine.allows(req) if engine is not None \
+                    else True
+                info = {"api_key": req.api_key, "topics": req.topics,
+                        "client_id": req.client_id,
+                        "correlation_id": req.correlation_id}
+                if allowed:
+                    self.correlation.put(req)
+                    up_w.write(frame)
+                    await up_w.drain()
+                    self._log(ctx, "forwarded", "kafka", src_id, dst_id,
+                              info)
+                else:
+                    client_w.write(kafka_deny_response(req))
+                    await client_w.drain()
+                    self._log(ctx, "denied", "kafka", src_id, dst_id,
+                              info)
+            try:
+                up_w.write_eof()
+            except OSError:
+                pass
+
+        async def reply_path():
+            buf = b""
+            while True:
+                frame, buf = await _read_kafka_frame(up_r, buf)
+                if frame is None:
+                    break
+                if len(frame) >= 8:
+                    (corr,) = struct.unpack_from(">i", frame, 4)
+                    entry = self.correlation.correlate(corr)
+                    if entry is not None:
+                        self._log(ctx, "response", "kafka", dst_id,
+                                  src_id,
+                                  {"correlation_id": corr,
+                                   "api_key": entry.api_key,
+                                   "topics": entry.topics,
+                                   "latency_ms": round(
+                                       (time.time() - entry.sent_at)
+                                       * 1000, 2)})
+                client_w.write(frame)
+                await client_w.drain()
+            try:
+                client_w.write_eof()
+            except OSError:
+                pass
+
+        await _run_both(request_path(), reply_path())
+
+    # ------------------------------------------------------------- http
+
+    async def _pump_http(self, client_r, client_w, up_r, up_w, ctx,
+                         peer, src_id, dst_id):
+        engine = ctx.http_engine_for(peer) if ctx.http_engine_for \
+            else None
+
+        async def request_path():
+            buf = b""
+            while True:
+                head, buf = await _read_http_head(client_r, buf)
+                if head is None:
+                    break
+                request_line, headers, raw_head = head
+                try:
+                    method, path, _version = request_line.split(" ", 2)
+                except ValueError:
+                    raise ConnectionResetError("bad request line")
+                if "chunked" in headers.get("transfer-encoding", ""):
+                    # not framed here; fail closed rather than smuggle
+                    raise ConnectionResetError("chunked not supported")
+                body_len = int(headers.get("content-length", "0") or 0)
+                while len(buf) < body_len:
+                    chunk = await client_r.read(65536)
+                    if not chunk:
+                        raise ConnectionResetError("truncated body")
+                    buf += chunk
+                body, buf = buf[:body_len], buf[body_len:]
+                req = HTTPRequest(method=method, path=path,
+                                  host=headers.get("host", ""),
+                                  headers=dict(headers))
+                allowed = engine.check_one(req) if engine is not None \
+                    else True
+                info = {"method": method, "path": path,
+                        "host": headers.get("host", "")}
+                if allowed:
+                    up_w.write(raw_head + body)
+                    await up_w.drain()
+                    self._log(ctx, "forwarded", "http", src_id, dst_id,
+                              info)
+                else:
+                    client_w.write(HTTP_DENY)
+                    await client_w.drain()
+                    self._log(ctx, "denied", "http", src_id, dst_id,
+                              info)
+                    raise ConnectionResetError("denied: close")
+            try:
+                up_w.write_eof()
+            except OSError:
+                pass
+
+        async def reply_path():
+            while True:
+                chunk = await up_r.read(65536)
+                if not chunk:
+                    break
+                client_w.write(chunk)
+                await client_w.drain()
+            try:
+                client_w.write_eof()
+            except OSError:
+                pass
+
+        await _run_both(request_path(), reply_path())
+
+
+async def _run_both(req_coro, rep_coro):
+    """Run both pumps; first exception cancels the peer."""
+    tasks = [asyncio.ensure_future(req_coro),
+             asyncio.ensure_future(rep_coro)]
+    try:
+        await asyncio.gather(*tasks)
+    except (ConnectionResetError, ConnectionError, asyncio.IncompleteReadError,
+            OSError):
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def _read_kafka_frame(reader: asyncio.StreamReader,
+                            buf: bytes) -> Tuple[Optional[bytes], bytes]:
+    """One size-prefixed Kafka frame (request or response)."""
+    while len(buf) < 4:
+        chunk = await reader.read(65536)
+        if not chunk:
+            return None, buf
+        buf += chunk
+    (size,) = struct.unpack_from(">i", buf, 0)
+    if size < 0 or size > (64 << 20):
+        raise ConnectionResetError("bad kafka frame size")
+    total = 4 + size
+    while len(buf) < total:
+        chunk = await reader.read(65536)
+        if not chunk:
+            return None, buf
+        buf += chunk
+    return buf[:total], buf[total:]
+
+
+async def _read_http_head(reader: asyncio.StreamReader, buf: bytes):
+    """Request line + headers.  Returns ((request_line, headers, raw),
+    leftover) or (None, leftover) on clean EOF before a request."""
+    while b"\r\n\r\n" not in buf:
+        chunk = await reader.read(65536)
+        if not chunk:
+            if buf:
+                raise ConnectionResetError("truncated http head")
+            return None, buf
+        buf += chunk
+        if len(buf) > (1 << 20):
+            raise ConnectionResetError("oversized http head")
+    head, rest = buf.split(b"\r\n\r\n", 1)
+    lines = head.decode("latin1").split("\r\n")
+    headers = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, v = line.split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    return (lines[0], headers, head + b"\r\n\r\n"), rest

@@ -1,0 +1,335 @@
+"""Socket-level L7 proxy data plane: real TCP through the policy path.
+
+Round-1 gap closed: redirects were in-process engine calls on already-
+parsed requests.  These tests run live connections through the proxy:
+
+- memcached via the generic parser framework (deny frames injected
+  in-protocol, upstream never sees denied requests);
+- kafka via the dedicated handler (typed error responses with matching
+  correlation ids; the correlation cache attributes responses and logs
+  latency — pkg/kafka/correlation_cache.go:97);
+- http/1.1 framing + 403 deny;
+- the full chain: packet batch -> datapath verdict = proxy_port ->
+  real TCP connect through that port -> denied in-protocol.
+"""
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.l7.socket_proxy import (CorrelationCache, ListenerContext,
+                                        SocketProxy,
+                                        TOPIC_AUTHORIZATION_FAILED,
+                                        kafka_deny_response)
+from cilium_tpu.l7.kafka import KafkaPolicyEngine, parse_kafka_request
+from cilium_tpu.l7.http import HTTPPolicyEngine
+from cilium_tpu.l7.parser import PortRuleL7
+from cilium_tpu.policy.api import PortRuleHTTP, PortRuleKafka
+from cilium_tpu.proxy import AccessLog
+
+
+class _Upstream(socketserver.ThreadingTCPServer):
+    """Records everything it receives; replies per handler_fn."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, handler_fn):
+        self.received = []
+        self.handler_fn = handler_fn
+        super().__init__(("127.0.0.1", 0), _UpHandler)
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+    @property
+    def port(self):
+        return self.server_address[1]
+
+
+class _UpHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                data = self.request.recv(65536)
+            except OSError:
+                return
+            if not data:
+                return
+            self.server.received.append(data)
+            reply = self.server.handler_fn(data)
+            if reply:
+                self.request.sendall(reply)
+
+
+def _connect(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.settimeout(5)
+    return s
+
+
+def _recv_until(sock, token, timeout=5):
+    deadline = time.time() + timeout
+    buf = b""
+    while token not in buf and time.time() < deadline:
+        try:
+            chunk = sock.recv(65536)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+@pytest.fixture()
+def proxy():
+    log = AccessLog()
+    sp = SocketProxy(access_log=log)
+    sp.test_log = log
+    yield sp
+    sp.shutdown()
+
+
+# ----------------------------------------------------- generic (memcached)
+
+def test_memcached_stream_through_proxy(proxy):
+    upstream = _Upstream(lambda data: b"END\r\n")
+    ctx = ListenerContext(
+        redirect_id="1:ingress:TCP:11211", parser_type="memcache",
+        orig_dst=lambda peer: ("127.0.0.1", upstream.port),
+        l7_rules=lambda peer: [PortRuleL7.from_dict(
+            {"command": "get", "key": "sess:*"})],
+        identities=lambda peer: (101, 202))
+    port = proxy.start_listener(0, ctx)
+    c = _connect(port)
+    try:
+        # allowed request reaches the upstream; reply flows back
+        c.sendall(b"get sess:42\r\n")
+        assert b"END\r\n" in _recv_until(c, b"END\r\n")
+        assert b"get sess:42\r\n" in b"".join(upstream.received)
+        # denied request: SERVER_ERROR injected in-protocol, upstream
+        # never sees it
+        c.sendall(b"get secret:1\r\n")
+        assert b"SERVER_ERROR" in _recv_until(c, b"\r\n")
+        assert b"secret" not in b"".join(upstream.received)
+    finally:
+        c.close()
+        upstream.shutdown()
+    verdicts = [e.verdict for e in proxy.test_log.tail()]
+    assert "forwarded" in verdicts and "denied" in verdicts
+    src_ids = {e.src_identity for e in proxy.test_log.tail()}
+    assert 101 in src_ids
+
+
+# -------------------------------------------------------------- kafka
+
+def _kafka_request(api_key, corr, topic, client=b"cli"):
+    # header: api_key, api_version=0, correlation, client_id
+    body = struct.pack(">hhi", api_key, 0, corr)
+    body += struct.pack(">h", len(client)) + client
+    if api_key == 0:  # produce v0: acks, timeout, topics
+        body += struct.pack(">hi", 1, 1000)
+        body += struct.pack(">i", 1)
+        body += struct.pack(">h", len(topic)) + topic
+        body += struct.pack(">i", 0)  # partitions: []
+    return struct.pack(">i", len(body)) + body
+
+
+def test_kafka_acl_and_correlation(proxy):
+    def broker(data):
+        # echo a response frame per request frame: size + corr + int16
+        out = b""
+        while len(data) >= 4:
+            (size,) = struct.unpack_from(">i", data, 0)
+            frame = data[:4 + size]
+            (corr,) = struct.unpack_from(">i", frame, 8)
+            payload = struct.pack(">ih", corr, 0)
+            out += struct.pack(">i", len(payload)) + payload
+            data = data[4 + size:]
+        return out
+
+    upstream = _Upstream(broker)
+    engine = KafkaPolicyEngine([PortRuleKafka(api_key="produce",
+                                              topic="allowed-topic")])
+    ctx = ListenerContext(
+        redirect_id="2:egress:TCP:9092", parser_type="kafka",
+        orig_dst=lambda peer: ("127.0.0.1", upstream.port),
+        kafka_engine_for=lambda peer: engine)
+    port = proxy.start_listener(0, ctx)
+    c = _connect(port)
+    try:
+        # allowed produce: forwarded; broker response correlated back
+        c.sendall(_kafka_request(0, 7, b"allowed-topic"))
+        resp = _recv_until(c, struct.pack(">i", 7))
+        assert len(resp) >= 8
+        (corr,) = struct.unpack_from(">i", resp, 4)
+        assert corr == 7
+        # denied produce: typed error response, correct correlation id,
+        # error code 29; never forwarded
+        before = len(b"".join(upstream.received))
+        c.sendall(_kafka_request(0, 9, b"forbidden-topic"))
+        resp = _recv_until(c, struct.pack(">i", 9))
+        (size,) = struct.unpack_from(">i", resp, 0)
+        (corr,) = struct.unpack_from(">i", resp, 4)
+        assert corr == 9
+        assert struct.pack(">h", TOPIC_AUTHORIZATION_FAILED) in resp
+        assert b"forbidden-topic" not in b"".join(
+            upstream.received)[before:]
+    finally:
+        c.close()
+        upstream.shutdown()
+    entries = proxy.test_log.tail()
+    verdicts = [e.verdict for e in entries]
+    assert "forwarded" in verdicts and "denied" in verdicts
+    responses = [e for e in entries if e.verdict == "response"]
+    assert responses and responses[0].info["correlation_id"] == 7
+    assert "latency_ms" in responses[0].info
+
+
+def test_kafka_deny_response_shapes():
+    for api_key in (0, 1, 3, 10):
+        req = parse_kafka_request(_kafka_request(0, 42, b"t"))
+        req.api_key = api_key
+        frame = kafka_deny_response(req)
+        (size,) = struct.unpack_from(">i", frame, 0)
+        assert len(frame) == 4 + size
+        (corr,) = struct.unpack_from(">i", frame, 4)
+        assert corr == 42
+
+
+def test_correlation_cache_capacity():
+    cache = CorrelationCache(capacity=2)
+    for i in range(4):
+        req = parse_kafka_request(_kafka_request(0, i, b"t"))
+        cache.put(req)
+    assert len(cache) == 2 and cache.overflows == 2
+    assert cache.correlate(3) is not None
+    assert cache.correlate(0) is None  # evicted
+
+
+# ---------------------------------------------------------------- http
+
+def test_http_allow_deny_through_proxy(proxy):
+    ok_response = (b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nhi")
+    upstream = _Upstream(lambda data: ok_response)
+    engine = HTTPPolicyEngine([PortRuleHTTP(method="GET",
+                                            path="/public/.*")])
+    ctx = ListenerContext(
+        redirect_id="3:ingress:TCP:80", parser_type="http",
+        orig_dst=lambda peer: ("127.0.0.1", upstream.port),
+        http_engine_for=lambda peer: engine)
+    port = proxy.start_listener(0, ctx)
+    c = _connect(port)
+    try:
+        c.sendall(b"GET /public/index.html HTTP/1.1\r\n"
+                  b"Host: site\r\ncontent-length: 0\r\n\r\n")
+        assert b"200 OK" in _recv_until(c, b"hi")
+    finally:
+        c.close()
+    c = _connect(port)
+    try:
+        before = len(b"".join(upstream.received))
+        c.sendall(b"POST /admin HTTP/1.1\r\nHost: site\r\n"
+                  b"content-length: 0\r\n\r\n")
+        resp = _recv_until(c, b"denied")
+        assert b"403" in resp
+        assert b"/admin" not in b"".join(upstream.received)[before:]
+    finally:
+        c.close()
+        upstream.shutdown()
+
+
+# ------------------------------------------------ full verdict -> socket
+
+def test_packet_verdict_to_socket_e2e(proxy):
+    """BASELINE's slow-path contract: the datapath's proxy_port verdict
+    IS the TCP port the proxied connection traverses."""
+    from cilium_tpu.compiler.policy_tables import compile_endpoints
+    from cilium_tpu.datapath.verdict import VerdictEngine, make_packet_batch
+    from cilium_tpu.policy.mapstate import (INGRESS, PolicyKey,
+                                            PolicyMapState,
+                                            PolicyMapStateEntry)
+    upstream = _Upstream(lambda data: b"END\r\n")
+    # redirect port allocated in the proxy range, used as the verdict
+    proxy_port = 10007
+    st = PolicyMapState()
+    st[PolicyKey(identity=301, dest_port=11211, nexthdr=6,
+                 direction=INGRESS)] = \
+        PolicyMapStateEntry(proxy_port=proxy_port)
+    eng = VerdictEngine(compile_endpoints([st], revision=1))
+    batch = make_packet_batch(endpoint=[0], identity=[301],
+                              dport=[11211], proto=[6], direction=[0],
+                              length=[64])
+    verdict = int(np.asarray(eng(batch))[0])
+    assert verdict == proxy_port
+    # the datapath says "redirect to proxy_port"; bind it and connect
+    ctx = ListenerContext(
+        redirect_id="7:ingress:TCP:11211", parser_type="memcache",
+        orig_dst=lambda peer: ("127.0.0.1", upstream.port),
+        l7_rules=lambda peer: [PortRuleL7.from_dict(
+            {"command": "get", "key": "ok*"})])
+    bound = proxy.start_listener(verdict, ctx)
+    assert bound == proxy_port
+    c = _connect(verdict)
+    try:
+        c.sendall(b"get secret\r\n")
+        assert b"SERVER_ERROR" in _recv_until(c, b"\r\n")
+        c.sendall(b"get ok:1\r\n")
+        assert b"END\r\n" in _recv_until(c, b"END\r\n")
+    finally:
+        c.close()
+        upstream.shutdown()
+
+
+# ------------------------------------------- ProxyManager integration
+
+def test_proxy_manager_activate_redirect():
+    """Redirect lifecycle drives the data plane: create -> activate
+    (listener on the allocated port, engines resolved per remote
+    labels) -> remove (listener gone)."""
+    from cilium_tpu.policy.api import L7Rules
+    from cilium_tpu.policy.l4 import (L4Filter, L7DataMap,
+                                      PARSER_TYPE_HTTP,
+                                      WILDCARD_SELECTOR)
+    from cilium_tpu.proxy import ProxyManager
+
+    upstream = _Upstream(
+        lambda data: b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok")
+    l7map = L7DataMap()
+    l7map[WILDCARD_SELECTOR] = L7Rules(
+        http=[PortRuleHTTP(method="GET", path="/api/.*")])
+    flt = L4Filter(port=8080, protocol="TCP", u8proto=6,
+                   l7_parser=PARSER_TYPE_HTTP, l7_rules_per_ep=l7map,
+                   ingress=True)
+    pm = ProxyManager()
+    try:
+        redir = pm.create_or_update_redirect(flt, endpoint_id=5)
+        bound = pm.activate_redirect(
+            redir, orig_dst=lambda peer: ("127.0.0.1", upstream.port))
+        assert bound == redir.proxy_port
+        c = _connect(bound)
+        try:
+            c.sendall(b"GET /api/x HTTP/1.1\r\nHost: h\r\n"
+                      b"content-length: 0\r\n\r\n")
+            assert b"200 OK" in _recv_until(c, b"ok")
+        finally:
+            c.close()
+        c = _connect(bound)
+        try:
+            c.sendall(b"GET /other HTTP/1.1\r\nHost: h\r\n"
+                      b"content-length: 0\r\n\r\n")
+            assert b"403" in _recv_until(c, b"denied")
+        finally:
+            c.close()
+        # removal tears the listener down
+        assert pm.remove_redirect(redir.id)
+        with pytest.raises(OSError):
+            _connect(bound)
+        assert any(e.verdict == "denied" for e in pm.access_log.tail())
+    finally:
+        pm.shutdown_dataplane()
+        upstream.shutdown()
