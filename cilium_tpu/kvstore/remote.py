@@ -21,6 +21,12 @@ from .server import recv_frame, send_frame
 
 DEFAULT_TTL = 15.0
 
+# Default per-request deadline.  An infinite default wait means a dead
+# server dispatch thread (or a dropped response frame) wedges the
+# calling daemon forever; ops that legitimately block longer — lock
+# acquisition — pass an explicit padded _timeout.
+DEFAULT_CALL_TIMEOUT = 30.0
+
 
 class RemoteError(RuntimeError):
     pass
@@ -31,9 +37,11 @@ class RemoteBackend(BackendOperations):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 42379,
                  lease_ttl: float = DEFAULT_TTL,
-                 connect_timeout: float = 5.0):
+                 connect_timeout: float = 5.0,
+                 call_timeout: float = DEFAULT_CALL_TIMEOUT):
         self.host, self.port = host, int(port)
         self.lease_ttl = lease_ttl
+        self.call_timeout = call_timeout
         self._sock = socket.create_connection((host, self.port),
                                               timeout=connect_timeout)
         self._sock.settimeout(None)
@@ -102,6 +110,8 @@ class RemoteBackend(BackendOperations):
 
     def _call(self, op: str, _timeout: Optional[float] = None,
               **args) -> dict:
+        if _timeout is None:
+            _timeout = self.call_timeout
         if self._closed.is_set():
             raise RemoteError("client closed")
         with self._mu:

@@ -35,6 +35,13 @@ from .memory import InMemoryBackend, MemStore
 
 DEFAULT_PORT = 42379  # etcd's 2379, out of the privileged/common range
 
+# Per-connection in-flight dispatch bound.  lock_path can legitimately
+# block for its full acquisition timeout, so several slots are needed to
+# keep keepalives flowing past a blocked lock — but a client flooding
+# requests must apply backpressure on its own socket rather than grow
+# one daemon thread per frame without limit.
+MAX_INFLIGHT = 64
+
 
 def send_frame(sock: socket.socket, obj: dict,
                lock: Optional[threading.Lock] = None) -> None:
@@ -93,10 +100,15 @@ class _Conn(socketserver.BaseRequestHandler):
         # (memory.py); this handler only does wire marshaling + watch
         # forwarding
         self.backend: Optional[InMemoryBackend] = None
+        # dlock guards watches/locks/finished: dispatch threads insert
+        # concurrently with finish() tearing down
+        self.dlock = threading.Lock()
+        self.finished = False
         # watch_id -> (Watcher, forwarder thread)
         self.watches: Dict[int, Tuple[Watcher, threading.Thread]] = {}
         # lock_id -> Lock handle
         self.locks: Dict[str, Lock] = {}
+        self._inflight = threading.BoundedSemaphore(MAX_INFLIGHT)
 
     def handle(self):
         self.request.settimeout(None)
@@ -108,7 +120,11 @@ class _Conn(socketserver.BaseRequestHandler):
             if req is None:
                 break
             # thread-per-request: lock_path blocks, and the connection
-            # must stay responsive to keepalives while it waits
+            # must stay responsive to keepalives while it waits — but
+            # bounded: past MAX_INFLIGHT we stop reading frames, which
+            # backpressures the client's socket instead of spawning an
+            # unbounded number of daemon threads
+            self._inflight.acquire()
             threading.Thread(target=self._dispatch, args=(req,),
                              daemon=True).start()
 
@@ -124,6 +140,8 @@ class _Conn(socketserver.BaseRequestHandler):
                     "kind": "lock"}
         except Exception as e:  # noqa: BLE001 — wire back, don't die
             resp = {"id": rid, "ok": False, "error": repr(e)}
+        finally:
+            self._inflight.release()
         try:
             send_frame(self.request, resp, self.wlock)
         except OSError:
@@ -179,10 +197,16 @@ class _Conn(socketserver.BaseRequestHandler):
             lock = be.lock_path(req["path"],
                                 timeout=float(req.get("timeout", 30.0)))
             lock_id = uuid.uuid4().hex
-            self.locks[lock_id] = lock
-            return {"lock_id": lock_id}
+            with self.dlock:
+                if not self.finished:
+                    self.locks[lock_id] = lock
+                    return {"lock_id": lock_id}
+            # connection tore down while we waited: don't strand the lock
+            lock.unlock()
+            raise KVLockError("connection closed during lock wait")
         if op == "unlock":
-            held = self.locks.pop(req["lock_id"], None)
+            with self.dlock:
+                held = self.locks.pop(req["lock_id"], None)
             if held:
                 held.unlock()
             return None
@@ -218,25 +242,35 @@ class _Conn(socketserver.BaseRequestHandler):
 
         t = threading.Thread(target=forward, daemon=True)
         t.start()
-        self.watches[watch_id] = (watcher, t)
+        with self.dlock:
+            if self.finished:
+                watcher.stop()
+                raise ValueError("connection closed")
+            self.watches[watch_id] = (watcher, t)
         return {}
 
     def _stop_watch(self, watch_id: int) -> None:
-        entry = self.watches.pop(int(watch_id), None)
+        with self.dlock:
+            entry = self.watches.pop(int(watch_id), None)
         if entry:
             entry[0].stop()
 
     def finish(self):
-        for watch_id in list(self.watches):
-            self._stop_watch(watch_id)
+        with self.dlock:
+            self.finished = True
+            watches = list(self.watches.values())
+            self.watches.clear()
+            locks = list(self.locks.values())
+            self.locks.clear()
+        for watcher, _t in watches:
+            watcher.stop()
         # held locks die with the connection (eager release avoids a
         # stuck allocator waiting a full TTL)
-        for lock in self.locks.values():
+        for lock in locks:
             try:
                 lock.unlock()
             except Exception:  # noqa: BLE001
                 pass
-        self.locks.clear()
         # the backend is NOT closed here: its session lives until the
         # TTL lapses, exactly like an etcd lease after the client
         # vanishes (close() would expire the lease immediately)

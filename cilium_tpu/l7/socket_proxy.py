@@ -170,7 +170,6 @@ class SocketProxy:
         self._servers: Dict[str, asyncio.AbstractServer] = {}
         self._next_conn_id = 0
         self._lock = threading.Lock()
-        self.correlation = CorrelationCache()
 
     def _run(self):
         asyncio.set_event_loop(self._loop)
@@ -352,6 +351,11 @@ class SocketProxy:
                           peer, src_id, dst_id):
         engine = ctx.kafka_engine_for(peer) if ctx.kafka_engine_for \
             else None
+        # Per-connection cache (pkg/proxy/kafka.go:335 allocates one per
+        # kafkaRedirect connection): correlation ids are a client-chosen
+        # per-connection namespace, so a proxy-wide cache would let two
+        # clients with colliding ids mis-attribute each other's responses.
+        correlation = CorrelationCache()
 
         async def request_path():
             buf = b""
@@ -374,7 +378,7 @@ class SocketProxy:
                         "client_id": req.client_id,
                         "correlation_id": req.correlation_id}
                 if allowed:
-                    self.correlation.put(req)
+                    correlation.put(req)
                     up_w.write(frame)
                     await up_w.drain()
                     self._log(ctx, "forwarded", "kafka", src_id, dst_id,
@@ -397,7 +401,7 @@ class SocketProxy:
                     break
                 if len(frame) >= 8:
                     (corr,) = struct.unpack_from(">i", frame, 4)
-                    entry = self.correlation.correlate(corr)
+                    entry = correlation.correlate(corr)
                     if entry is not None:
                         self._log(ctx, "response", "kafka", dst_id,
                                   src_id,
@@ -437,7 +441,7 @@ class SocketProxy:
                 if "chunked" in headers.get("transfer-encoding", ""):
                     # not framed here; fail closed rather than smuggle
                     raise ConnectionResetError("chunked not supported")
-                body_len = int(headers.get("content-length", "0") or 0)
+                body_len = _content_length(headers)
                 while len(buf) < body_len:
                     chunk = await client_r.read(65536)
                     if not chunk:
@@ -515,9 +519,31 @@ async def _read_kafka_frame(reader: asyncio.StreamReader,
     return buf[:total], buf[total:]
 
 
+def _content_length(headers: Dict[str, str]) -> int:
+    """Strict request-framing length.  Every request byte the proxy
+    forwards is framed off this value, so anything ambiguous is a
+    smuggling vector and MUST fail closed (the reference delegates this
+    to Envoy's codec, which rejects the same inputs): negative values
+    would make the read loop skip and ``buf[:body_len]`` mis-frame,
+    letting pipelined bytes after an allowed head reach upstream
+    unchecked; ``+``/whitespace/hex forms are parser-dependent."""
+    raw = headers.get("content-length")
+    if raw is None:
+        return 0
+    if not raw.isdigit():
+        # rejects "", "-5", "+5", " 5", "0x10", "5, 5" — digits only
+        raise ConnectionResetError("bad content-length")
+    return int(raw)
+
+
 async def _read_http_head(reader: asyncio.StreamReader, buf: bytes):
     """Request line + headers.  Returns ((request_line, headers, raw),
-    leftover) or (None, leftover) on clean EOF before a request."""
+    leftover) or (None, leftover) on clean EOF before a request.
+
+    Duplicate framing-critical headers (Content-Length,
+    Transfer-Encoding) fail the connection closed: a last-wins dict
+    would silently desync this proxy's framing from the upstream's
+    (classic CL.CL request smuggling)."""
     while b"\r\n\r\n" not in buf:
         chunk = await reader.read(65536)
         if not chunk:
@@ -533,5 +559,9 @@ async def _read_http_head(reader: asyncio.StreamReader, buf: bytes):
     for line in lines[1:]:
         if ":" in line:
             k, v = line.split(":", 1)
-            headers[k.strip().lower()] = v.strip()
+            key = k.strip().lower()
+            if key in headers and key in ("content-length",
+                                          "transfer-encoding"):
+                raise ConnectionResetError(f"duplicate {key}")
+            headers[key] = v.strip()
     return (lines[0], headers, head + b"\r\n\r\n"), rest

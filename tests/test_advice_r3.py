@@ -1,0 +1,355 @@
+"""Regression tests for the round-3 ADVICE.md findings.
+
+Each test fails on the pre-fix code:
+
+1. (high) l7/socket_proxy.py HTTP framing accepted a negative /
+   non-numeric Content-Length and last-wins duplicate headers —
+   request-smuggling: pipelined bytes after an allowed head reached the
+   upstream unchecked (buf[:-N] mis-framing).
+2. (med) Kafka CorrelationCache was proxy-wide; colliding correlation
+   ids across client connections mis-attributed response-path access
+   logs (reference allocates per connection, pkg/proxy/kafka.go:335).
+3. (med) kvstore server spawned one unbounded daemon thread per frame
+   and mutated locks/watches without synchronization against finish();
+   a lock granted after the connection died was stranded until lease
+   expiry.
+4. (med) kvstore RemoteBackend._call defaulted to an infinite wait — a
+   dead server dispatch thread wedged the calling daemon forever.
+"""
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.kvstore.remote import RemoteBackend, RemoteError
+from cilium_tpu.kvstore.server import (KVStoreServer, MAX_INFLIGHT,
+                                       recv_frame, send_frame)
+from cilium_tpu.l7.kafka import KafkaPolicyEngine
+from cilium_tpu.l7.socket_proxy import ListenerContext, SocketProxy
+from cilium_tpu.policy.api import PortRuleHTTP, PortRuleKafka
+from cilium_tpu.l7.http import HTTPPolicyEngine
+from cilium_tpu.proxy import AccessLog
+
+
+class _Upstream(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, handler_fn):
+        self.received = []
+        self.handler_fn = handler_fn
+        super().__init__(("127.0.0.1", 0), _UpHandler)
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+    @property
+    def port(self):
+        return self.server_address[1]
+
+
+class _UpHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                data = self.request.recv(65536)
+            except OSError:
+                return
+            if not data:
+                return
+            self.server.received.append(data)
+            reply = self.server.handler_fn(data)
+            if reply:
+                self.request.sendall(reply)
+
+
+def _connect(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.settimeout(5)
+    return s
+
+
+def _drain(sock, timeout=2):
+    """Read until EOF/reset/timeout; returns whatever arrived."""
+    deadline = time.time() + timeout
+    sock.settimeout(0.2)
+    buf = b""
+    while time.time() < deadline:
+        try:
+            chunk = sock.recv(65536)
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+@pytest.fixture()
+def proxy():
+    log = AccessLog()
+    sp = SocketProxy(access_log=log)
+    sp.test_log = log
+    yield sp
+    sp.shutdown()
+
+
+# ------------------------------------------------- 1. HTTP CL smuggling
+
+def _http_ctx(upstream, paths="/public/.*"):
+    engine = HTTPPolicyEngine([PortRuleHTTP(path=paths)])
+    return ListenerContext(
+        redirect_id="r:ingress:TCP:80", parser_type="http",
+        orig_dst=lambda peer: ("127.0.0.1", upstream.port),
+        http_engine_for=lambda peer: engine)
+
+
+def test_http_negative_content_length_fails_closed(proxy):
+    """An allowed head with CL:-13 followed by a pipelined disallowed
+    request: old code skipped the body read, mis-framed buf[:-13], and
+    forwarded the smuggled bytes upstream unchecked."""
+    upstream = _Upstream(lambda data: None)
+    port = proxy.start_listener(0, _http_ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                  b"Content-Length: -13\r\n\r\n"
+                  b"GET /secret HTTP/1.1\r\n\r\n")
+        _drain(c)
+    finally:
+        c.close()
+        upstream.shutdown()
+    blob = b"".join(upstream.received)
+    assert b"secret" not in blob
+    assert b"/public/a" not in blob  # whole exchange failed closed
+
+
+def test_http_duplicate_content_length_fails_closed(proxy):
+    """CL.CL desync: last-wins dict made this proxy frame with 26 while
+    an upstream honoring the first CL framed with 0."""
+    upstream = _Upstream(lambda data: None)
+    port = proxy.start_listener(0, _http_ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                  b"Content-Length: 0\r\n"
+                  b"Content-Length: 26\r\n\r\n"
+                  b"DELETE /secret HTTP/1.1\r\n\r\n")
+        _drain(c)
+    finally:
+        c.close()
+        upstream.shutdown()
+    assert b"secret" not in b"".join(upstream.received)
+    assert not upstream.received
+
+
+def test_http_non_numeric_content_length_fails_closed(proxy):
+    upstream = _Upstream(lambda data: None)
+    port = proxy.start_listener(0, _http_ctx(upstream))
+    # (OWS around the value is stripped at parse — that form is
+    # unambiguous; these are the parser-dependent ones)
+    for bad in (b"+5", b"5x", b"0x10", b"5 5", b""):
+        c = _connect(port)
+        try:
+            c.sendall(b"GET /public/a HTTP/1.1\r\nHost: h\r\n"
+                      b"Content-Length: " + bad + b"\r\n\r\nhello")
+            _drain(c)
+        finally:
+            c.close()
+    upstream.shutdown()
+    assert not upstream.received
+
+
+def test_http_valid_content_length_still_forwards(proxy):
+    ok = b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok"
+    upstream = _Upstream(lambda data: ok)
+    port = proxy.start_listener(0, _http_ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                  b"Content-Length: 5\r\n\r\nhello")
+        assert b"200 OK" in _drain(c)
+    finally:
+        c.close()
+        upstream.shutdown()
+    assert b"hello" in b"".join(upstream.received)
+
+
+# ------------------------------------- 2. Kafka per-connection cache
+
+def _kafka_request(corr, topic, client=b"cli"):
+    body = struct.pack(">hhi", 0, 0, corr)          # produce v0
+    body += struct.pack(">h", len(client)) + client
+    body += struct.pack(">hi", 1, 1000)             # acks, timeout
+    body += struct.pack(">i", 1)                    # one topic
+    body += struct.pack(">h", len(topic)) + topic
+    body += struct.pack(">i", 0)                    # partitions: []
+    return struct.pack(">i", len(body)) + body
+
+
+def test_kafka_correlation_cache_is_per_connection(proxy):
+    """Two clients, same correlation id 7, different topics.  The broker
+    holds replies until both requests arrive, so with a proxy-wide cache
+    the second put overwrites the first and one response gets the wrong
+    topics while the other correlates to nothing."""
+    both_in = threading.Event()
+    count = [0]
+    mu = threading.Lock()
+
+    def broker(data):
+        with mu:
+            count[0] += 1
+            if count[0] >= 2:
+                both_in.set()
+        both_in.wait(5)
+        out = b""
+        while len(data) >= 4:
+            (size,) = struct.unpack_from(">i", data, 0)
+            (corr,) = struct.unpack_from(">i", data, 8)
+            payload = struct.pack(">ih", corr, 0)
+            out += struct.pack(">i", len(payload)) + payload
+            data = data[4 + size:]
+        return out
+
+    upstream = _Upstream(broker)
+    engine = KafkaPolicyEngine([
+        PortRuleKafka(api_key="produce", topic="topic-a"),
+        PortRuleKafka(api_key="produce", topic="topic-b")])
+    ctx = ListenerContext(
+        redirect_id="k:egress:TCP:9092", parser_type="kafka",
+        orig_dst=lambda peer: ("127.0.0.1", upstream.port),
+        kafka_engine_for=lambda peer: engine)
+    port = proxy.start_listener(0, ctx)
+    a, b = _connect(port), _connect(port)
+    try:
+        a.sendall(_kafka_request(7, b"topic-a"))
+        b.sendall(_kafka_request(7, b"topic-b"))
+        ra, rb = _drain(a), _drain(b)
+        assert ra and rb  # both clients got their broker reply
+    finally:
+        a.close()
+        b.close()
+        upstream.shutdown()
+    responses = [e for e in proxy.test_log.tail()
+                 if e.verdict == "response"]
+    topics = sorted(tuple(e.info["topics"]) for e in responses)
+    assert topics == [("topic-a",), ("topic-b",)]
+
+
+# -------------------------------- 3. kvstore server dispatch bounding
+
+def _raw_frames(port, frames, hold=True):
+    """Open a raw client, send hello + the given request frames."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    send_frame(s, {"id": 1, "op": "hello", "ttl": 30})
+    resp = recv_frame(s)
+    assert resp and resp["ok"]
+    for i, fr in enumerate(frames, start=2):
+        fr = dict(fr)
+        fr["id"] = i
+        send_frame(s, fr)
+    return s
+
+
+def test_server_dispatch_thread_count_is_bounded():
+    """Flood 4×MAX_INFLIGHT blocking lock requests on one connection:
+    dispatch threads must plateau at MAX_INFLIGHT, not one per frame."""
+    server = KVStoreServer(port=0).start()
+    holder = RemoteBackend(port=server.port, lease_ttl=30)
+    lock = holder.lock_path("/flood", timeout=5)
+    before = threading.active_count()
+    flood = _raw_frames(
+        server.port,
+        [{"op": "lock", "path": "/flood", "timeout": 20}] * (
+            MAX_INFLIGHT * 4))
+    time.sleep(1.0)  # let the server read + dispatch what it will
+    grown = threading.active_count() - before
+    try:
+        assert grown <= MAX_INFLIGHT + 8, \
+            f"dispatch threads unbounded: +{grown}"
+    finally:
+        flood.close()
+        lock.unlock()
+        holder.close()
+        server.shutdown()
+
+
+def test_lock_granted_after_connection_death_is_released():
+    """B waits for a lock, dies; A unlocks; the grant must not be
+    stranded in the dead connection's lock table — C acquires fast
+    (old code: stranded until B's 30s lease expired)."""
+    server = KVStoreServer(port=0).start()
+    a = RemoteBackend(port=server.port, lease_ttl=30)
+    lock_a = a.lock_path("/contended", timeout=5)
+
+    b = RemoteBackend(port=server.port, lease_ttl=30)
+    b_started = threading.Event()
+
+    def b_waits():
+        b_started.set()
+        try:
+            b.lock_path("/contended", timeout=20)
+        except (RemoteError, Exception):  # noqa: BLE001 — conn dies
+            pass
+
+    threading.Thread(target=b_waits, daemon=True).start()
+    b_started.wait(5)
+    time.sleep(0.3)      # B's lock request is now parked server-side
+    b.close()            # kill B mid-wait
+    time.sleep(0.2)      # server runs finish() for B's connection
+    lock_a.unlock()      # grant goes to B's dead dispatch thread
+
+    c = RemoteBackend(port=server.port, lease_ttl=30)
+    t0 = time.time()
+    lock_c = c.lock_path("/contended", timeout=3)
+    elapsed = time.time() - t0
+    lock_c.unlock()
+    for cli in (a, c):
+        cli.close()
+    server.shutdown()
+    assert elapsed < 2.0, f"lock stranded on dead connection ({elapsed:.1f}s)"
+
+
+# ----------------------------------------- 4. finite remote timeouts
+
+class _BlackholeServer:
+    """Speaks hello, then swallows every subsequent request."""
+
+    def __init__(self):
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(1)
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        conn, _ = self._srv.accept()
+        req = recv_frame(conn)
+        send_frame(conn, {"id": req["id"], "ok": True, "session": "s"})
+        while recv_frame(conn) is not None:
+            pass  # swallow
+
+    def close(self):
+        self._srv.close()
+
+
+def test_remote_call_times_out_instead_of_hanging():
+    bh = _BlackholeServer()
+    client = RemoteBackend(port=bh.port, lease_ttl=30, call_timeout=1.0)
+    t0 = time.time()
+    with pytest.raises(RemoteError, match="timed out"):
+        client.get("/k")
+    assert time.time() - t0 < 5.0
+    client.close()
+    bh.close()
+
+
+def test_remote_default_call_timeout_is_finite():
+    from cilium_tpu.kvstore.remote import DEFAULT_CALL_TIMEOUT
+    assert DEFAULT_CALL_TIMEOUT is not None
+    assert 0 < DEFAULT_CALL_TIMEOUT < float("inf")
