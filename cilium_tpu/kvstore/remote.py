@@ -215,9 +215,24 @@ class RemoteBackend(BackendOperations):
 
     def lock_path(self, path: str, timeout: float = 30.0) -> Lock:
         # server enforces the acquisition timeout; our wait is padded
-        # so the grant/timeout response always arrives first
-        resp = self._call("lock", _timeout=timeout + 10.0, path=path,
-                          timeout=timeout)
+        # so the grant/timeout response normally arrives first.  If our
+        # wait still expires (e.g. the frame sat unread behind the
+        # server's dispatch bound, so its clock started late), tell the
+        # server the wait is abandoned — whichever side the grant raced
+        # to releases it, so no lock is stranded on a live connection
+        # with no client handle.
+        import uuid as _uuid
+        ref = _uuid.uuid4().hex
+        try:
+            resp = self._call("lock", _timeout=timeout + 10.0, path=path,
+                              timeout=timeout, lock_ref=ref)
+        except RemoteError:
+            if not self._closed.is_set():
+                try:
+                    self._call("abort_lock", _timeout=5.0, lock_ref=ref)
+                except (RemoteError, KVLockError):
+                    pass
+            raise
         return Lock(self, path, resp["lock_id"])
 
     def _unlock(self, path: str, token: str) -> None:

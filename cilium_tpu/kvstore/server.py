@@ -35,12 +35,16 @@ from .memory import InMemoryBackend, MemStore
 
 DEFAULT_PORT = 42379  # etcd's 2379, out of the privileged/common range
 
-# Per-connection in-flight dispatch bound.  lock_path can legitimately
-# block for its full acquisition timeout, so several slots are needed to
-# keep keepalives flowing past a blocked lock — but a client flooding
-# requests must apply backpressure on its own socket rather than grow
-# one daemon thread per frame without limit.
+# Per-connection in-flight bound for *blocking* ops (lock acquisition).
+# Fast ops are dispatched inline on the reader thread, so the reader is
+# only ever parked in recv_frame — it sees client EOF promptly and
+# finish() releases held locks/watches eagerly.  Lock requests past the
+# bound fail fast with a lock error instead of queuing daemon threads.
 MAX_INFLIGHT = 64
+
+# Server-side cap on the client-requested lock acquisition timeout, so a
+# hostile client can't park dispatch threads forever.
+MAX_LOCK_TIMEOUT = 120.0
 
 
 def send_frame(sock: socket.socket, obj: dict,
@@ -108,6 +112,11 @@ class _Conn(socketserver.BaseRequestHandler):
         self.watches: Dict[int, Tuple[Watcher, threading.Thread]] = {}
         # lock_id -> Lock handle
         self.locks: Dict[str, Lock] = {}
+        # client-supplied lock_ref bookkeeping for abandoned waits:
+        # refs the client aborted before the grant arrived, and
+        # ref -> lock_id for aborts that race past the grant
+        self.aborted_refs: set = set()
+        self.granted_refs: Dict[str, str] = {}
         self._inflight = threading.BoundedSemaphore(MAX_INFLIGHT)
 
     def handle(self):
@@ -119,16 +128,31 @@ class _Conn(socketserver.BaseRequestHandler):
                 break
             if req is None:
                 break
-            # thread-per-request: lock_path blocks, and the connection
-            # must stay responsive to keepalives while it waits — but
-            # bounded: past MAX_INFLIGHT we stop reading frames, which
-            # backpressures the client's socket instead of spawning an
-            # unbounded number of daemon threads
-            self._inflight.acquire()
-            threading.Thread(target=self._dispatch, args=(req,),
-                             daemon=True).start()
+            if req.get("op") == "lock":
+                # only lock acquisition may block long; it runs on its
+                # own thread so keepalives keep flowing, bounded so a
+                # flood fails fast instead of growing a thread per frame
+                if self._inflight.acquire(blocking=False):
+                    threading.Thread(target=self._dispatch,
+                                     args=(req, True),
+                                     daemon=True).start()
+                else:
+                    self._respond({"id": req.get("id"), "ok": False,
+                                   "error": "too many pending locks",
+                                   "kind": "lock"})
+            else:
+                # fast ops run inline: the reader thread is otherwise
+                # always parked in recv_frame, so EOF -> finish() is
+                # prompt even while lock threads wait
+                self._dispatch(req, False)
 
-    def _dispatch(self, req: dict) -> None:
+    def _respond(self, resp: dict) -> None:
+        try:
+            send_frame(self.request, resp, self.wlock)
+        except OSError:
+            pass
+
+    def _dispatch(self, req: dict, holds_slot: bool) -> None:
         rid = req.get("id")
         try:
             result = self._handle_op(req)
@@ -141,11 +165,9 @@ class _Conn(socketserver.BaseRequestHandler):
         except Exception as e:  # noqa: BLE001 — wire back, don't die
             resp = {"id": rid, "ok": False, "error": repr(e)}
         finally:
-            self._inflight.release()
-        try:
-            send_frame(self.request, resp, self.wlock)
-        except OSError:
-            pass
+            if holds_slot:
+                self._inflight.release()
+        self._respond(resp)
 
     # ------------------------------------------------------------- ops
 
@@ -194,19 +216,47 @@ class _Conn(socketserver.BaseRequestHandler):
             self._stop_watch(req["watch_id"])
             return None
         if op == "lock":
-            lock = be.lock_path(req["path"],
-                                timeout=float(req.get("timeout", 30.0)))
+            timeout = min(float(req.get("timeout", 30.0)),
+                          MAX_LOCK_TIMEOUT)
+            lock = be.lock_path(req["path"], timeout=timeout)
             lock_id = uuid.uuid4().hex
+            lock_ref = req.get("lock_ref")
             with self.dlock:
-                if not self.finished:
+                if self.finished:
+                    pass  # fall through: connection died while we waited
+                elif lock_ref is not None and \
+                        lock_ref in self.aborted_refs:
+                    # client gave up (its own wait timed out) before the
+                    # grant: release instead of stranding a lock the
+                    # client has no handle to
+                    self.aborted_refs.discard(lock_ref)
+                else:
                     self.locks[lock_id] = lock
+                    if lock_ref is not None:
+                        self.granted_refs[lock_ref] = lock_id
                     return {"lock_id": lock_id}
-            # connection tore down while we waited: don't strand the lock
             lock.unlock()
-            raise KVLockError("connection closed during lock wait")
+            raise KVLockError("lock wait abandoned")
+        if op == "abort_lock":
+            # client-side lock wait timed out; whether the grant already
+            # happened decides which side releases
+            ref = req["lock_ref"]
+            held = None
+            with self.dlock:
+                lock_id = self.granted_refs.pop(ref, None)
+                if lock_id is not None:
+                    held = self.locks.pop(lock_id, None)
+                else:
+                    self.aborted_refs.add(ref)
+            if held:
+                held.unlock()
+            return None
         if op == "unlock":
             with self.dlock:
                 held = self.locks.pop(req["lock_id"], None)
+                self.granted_refs = {r: lid for r, lid
+                                     in self.granted_refs.items()
+                                     if lid != req["lock_id"]}
             if held:
                 held.unlock()
             return None
@@ -262,6 +312,8 @@ class _Conn(socketserver.BaseRequestHandler):
             self.watches.clear()
             locks = list(self.locks.values())
             self.locks.clear()
+            self.granted_refs.clear()
+            self.aborted_refs.clear()
         for watcher, _t in watches:
             watcher.stop()
         # held locks die with the connection (eager release avoids a

@@ -530,7 +530,10 @@ def _content_length(headers: Dict[str, str]) -> int:
     raw = headers.get("content-length")
     if raw is None:
         return 0
-    if not raw.isdigit():
+    # ascii check matters: str.isdigit() accepts latin-1 superscripts
+    # ("\xb2") that int() then rejects with a ValueError outside the
+    # connection-error handling path
+    if not (raw.isascii() and raw.isdigit()):
         # rejects "", "-5", "+5", " 5", "0x10", "5, 5" — digits only
         raise ConnectionResetError("bad content-length")
     return int(raw)
