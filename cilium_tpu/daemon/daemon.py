@@ -80,13 +80,27 @@ class Daemon:
         self.dns_poller: Optional[DNSPoller] = None
         self.started_at = time.time()
 
+        # the node manager must exist before the registry: registry
+        # construction synchronously replays pre-existing nodes into
+        # _on_node_update, which programs it
+        self.node_manager = NodeManager(
+            f"{self.config.cluster_name}/{node_name}",
+            ipcache=self.ipcache,
+            mode="tunnel" if self.config.tunnel != "disabled" else "direct",
+            datapath=self.datapath)
+
         # identity allocation: distributed when a kvstore is attached
         # (daemon.go:1295 InitIdentityAllocator)
         self.kv = kvstore_backend
         if self.kv is not None:
+            # remote identity churn must retrigger endpoint policy
+            # recompute (pkg/identity identityWatcher ->
+            # TriggerPolicyUpdates): a peer node allocating a new
+            # identity changes what our selectors match
             self.identity_allocator = DistributedIdentityAllocator(
                 self.kv, node=node_name,
-                cluster_id=self.config.cluster_id)
+                cluster_id=self.config.cluster_id,
+                on_change=self._on_identity_change)
             self._ip_syncer = KVStoreIPCacheSyncer(self.kv)
             self.ipcache.add_listener(self._ip_syncer.listener(),
                                       replay=False)
@@ -102,10 +116,6 @@ class Daemon:
             self._ip_syncer = None
             self._ip_watcher = None
             self.node_registry = None
-        self.node_manager = NodeManager(
-            f"{self.config.cluster_name}/{node_name}",
-            ipcache=self.ipcache,
-            mode="tunnel" if self.config.tunnel != "disabled" else "direct")
         self.clustermesh = ClusterMesh(
             ipcache=self.ipcache,
             on_node_update=self.node_manager.node_updated,
@@ -141,6 +151,13 @@ class Daemon:
                 do_func=lambda: self.datapath.gc(), run_interval=5.0))
 
     # ------------------------------------------------------------ nodes
+
+    def _on_identity_change(self, _typ: str, _ident) -> None:
+        # may fire during __init__ (watch replay) before the trigger
+        # exists; those identities are covered by the first build anyway
+        trigger = getattr(self, "_regen_trigger", None)
+        if trigger is not None:
+            trigger.trigger("identity-change")
 
     def _on_node_update(self, node: Node) -> None:
         self.node_manager.node_updated(node)
@@ -358,6 +375,8 @@ class Daemon:
         self.endpoints.insert(ep)
         ep.update_labels(self.identity_allocator,
                          Labels.from_model(list(labels or [])))
+        self.datapath.set_endpoint_identity(ep.table_slot,
+                                            ep.security_identity)
         IDENTITY_COUNT.set(len(self.identity_allocator))
         if ipv4:
             self.ipcache.upsert(ipv4, ep.security_identity,
@@ -402,6 +421,9 @@ class Daemon:
         changed = ep.update_labels(self.identity_allocator,
                                    Labels.from_model(list(labels)))
         if changed:
+            if ep.table_slot is not None:
+                self.datapath.set_endpoint_identity(ep.table_slot,
+                                                    ep.security_identity)
             if ep.ipv4:
                 self.ipcache.upsert(ep.ipv4, ep.security_identity,
                                     SOURCE_AGENT_LOCAL,
@@ -455,6 +477,8 @@ class Daemon:
             ep.table_slot = self.table_mgr.attach(ep.id)
             self.endpoints.insert(ep)
             ep.update_labels(self.identity_allocator, ep.labels)
+            self.datapath.set_endpoint_identity(ep.table_slot,
+                                                ep.security_identity)
             if ep.ipv4:
                 self.ipcache.upsert(ep.ipv4, ep.security_identity,
                                     SOURCE_AGENT_LOCAL,

@@ -48,6 +48,13 @@ class Datapath:
         self.ct = ConntrackTable(slots=ct_slots, max_probe=ct_probe)
         self.compiled_policy: Optional[CompiledPolicy] = None
         self.compiled_ipcache: Optional[CompiledLPM] = None
+        # tunnel map (pkg/maps/tunnel): pod CIDR -> tunnel endpoint u32,
+        # programmed by the NodeManager on node add/delete
+        self.tunnel_prefixes: Dict[str, int] = {}
+        self.compiled_tunnel: Optional[CompiledLPM] = None
+        # endpoint slot -> the endpoint's own security identity (the
+        # per-endpoint SECLABEL the encap stage stamps into tunnel keys)
+        self._ep_identity = np.zeros(8, np.int32)
         self.counters: Optional[Counters] = None
         self.revision = 0
         self._step = None
@@ -114,6 +121,38 @@ class Datapath:
             self.compiled_ipcache = compile_lpm(prefixes)
             self._rebuild()
 
+    def load_tunnel(self, prefixes: Dict[str, int]) -> None:
+        """Program the tunnel map: pod CIDR -> tunnel endpoint node IP
+        (u32).  Reference: pkg/maps/tunnel SetTunnelEndpoint, consumed
+        by encap.h encap_and_redirect."""
+        # node IPs above 2^31 must be stored as their int32
+        # bit-pattern (the LPM value lanes are int32)
+        normalized = {cidr: int(np.uint32(ip).view(np.int32))
+                      for cidr, ip in prefixes.items()}
+        with self._lock:
+            if normalized == self.tunnel_prefixes:
+                return  # idempotent node refresh: skip the re-jit
+            self.tunnel_prefixes = normalized
+            self.compiled_tunnel = compile_lpm(self.tunnel_prefixes) \
+                if self.tunnel_prefixes else None
+            self._rebuild()
+
+    def set_endpoint_identity(self, slot: int, identity: int) -> None:
+        """Record a local endpoint slot's own security identity (the
+        compile-time SECLABEL of the reference's per-endpoint program);
+        the encap stage stamps it into the tunnel key."""
+        with self._lock:
+            if slot >= self._ep_identity.shape[0]:
+                grown = np.zeros(max(slot + 1,
+                                     2 * self._ep_identity.shape[0]),
+                                 np.int32)
+                grown[:self._ep_identity.shape[0]] = self._ep_identity
+                self._ep_identity = grown
+            self._ep_identity[slot] = identity
+            if self._tables is not None:
+                self._tables = self._tables._replace(
+                    ep_identity=jnp.asarray(self._ep_identity))
+
     def reload_services(self) -> None:
         with self._lock:
             self._rebuild()
@@ -153,11 +192,23 @@ class Datapath:
         pf = self.prefilter._compiled
         if pf is None or pf.entry_count() == 0:
             pf = compile_lpm({})
+        tun = self.compiled_tunnel
+        tun_kwargs = {}
+        tun_probe = 0
+        if tun is not None and tun.entry_count() > 0:
+            tun_probe = max(1, tun.max_probe)
+            tun_kwargs = dict(
+                tun_masks=jnp.asarray(tun.masks),
+                tun_key_a=jnp.asarray(tun.key_a),
+                tun_key_b=jnp.asarray(tun.key_b),
+                tun_value=jnp.asarray(tun.value),
+                tun_plens=jnp.asarray(tun.prefix_lens),
+                ep_identity=jnp.asarray(self._ep_identity))
         self._tables = FullTables(
             datapath=dp, lb=self.lb.compiled.tables,
             pf_masks=jnp.asarray(pf.masks), pf_key_a=jnp.asarray(pf.key_a),
             pf_key_b=jnp.asarray(pf.key_b), pf_value=jnp.asarray(pf.value),
-            pf_plens=jnp.asarray(pf.prefix_lens))
+            pf_plens=jnp.asarray(pf.prefix_lens), **tun_kwargs)
         if self.counters is None or self.counters.packets.shape[0] != n:
             self.counters = Counters(packets=jnp.zeros(n, jnp.uint32),
                                      bytes=jnp.zeros(n, jnp.uint32))
@@ -167,7 +218,8 @@ class Datapath:
             lpm_probe=max(1, self.compiled_ipcache.max_probe),
             pf_probe=max(1, pf.max_probe),
             lb_probe=self.lb.compiled.max_probe,
-            ct_slots=self.ct.slots, ct_probe=self.ct.max_probe),
+            ct_slots=self.ct.slots, ct_probe=self.ct.max_probe,
+            tun_probe=tun_probe),
             donate_argnums=(1, 2))
 
     # -- the hot path --------------------------------------------------------
@@ -194,7 +246,8 @@ class Datapath:
 
 def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
                     direction=None, tcp_flags=None, length=None,
-                    is_fragment=None) -> FullPacketBatch:
+                    is_fragment=None, from_overlay=None,
+                    tunnel_id=None) -> FullPacketBatch:
     n = len(np.asarray(endpoint))
     arr = lambda x, d: jnp.asarray(np.asarray(
         x if x is not None else np.full(n, d), np.int32))
@@ -210,8 +263,13 @@ def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
             a = a.view(_np.int32)
         return jnp.asarray(a.astype(_np.int32) if a.dtype != _np.int32 else a)
 
+    overlay_fields = {}
+    if from_overlay is not None or tunnel_id is not None:
+        overlay_fields = dict(from_overlay=arr(from_overlay, 0),
+                              tunnel_id=arr(tunnel_id, 0))
     return FullPacketBatch(
         endpoint=arr(endpoint, 0), saddr=addr(saddr), daddr=addr(daddr),
         sport=arr(sport, 0), dport=arr(dport, 0), proto=arr(proto, 6),
         direction=arr(direction, 1), tcp_flags=arr(tcp_flags, 0x02),
-        length=arr(length, 100), is_fragment=arr(is_fragment, 0))
+        length=arr(length, 100), is_fragment=arr(is_fragment, 0),
+        **overlay_fields)

@@ -104,7 +104,15 @@ def make_step(compiled_policy: CompiledPolicy, compiled_lpm: CompiledLPM):
 # ---------------------------------------------------------------------------
 
 class FullPacketBatch(NamedTuple):
-    """Wire-level metadata for the full path, all [B] int32."""
+    """Wire-level metadata for the full path, all [B] int32.
+
+    ``from_overlay``/``tunnel_id`` model the tunnel header of packets
+    that arrived encapsulated from a peer node (bpf_overlay.c:151
+    from-overlay + skb_get_tunnel_key): where ``from_overlay`` is
+    nonzero, the source security identity is taken from ``tunnel_id``
+    — the identity the sending node stamped into the tunnel key — not
+    re-derived from the ipcache.  Both default to None (no overlay
+    traffic in the batch)."""
 
     endpoint: jnp.ndarray
     saddr: jnp.ndarray
@@ -116,18 +124,25 @@ class FullPacketBatch(NamedTuple):
     tcp_flags: jnp.ndarray
     length: jnp.ndarray
     is_fragment: jnp.ndarray
+    from_overlay: jnp.ndarray = None
+    tunnel_id: jnp.ndarray = None
 
 
 class NATResult(NamedTuple):
-    """Post-NAT packet tuple: forward packets carry the DNAT'd
+    """Post-NAT forwarding result: forward packets carry the DNAT'd
     destination; reply packets carry the rev-NAT'd (VIP-restored)
-    source. All [B] int32."""
+    source.  ``tunnel_ep``/``tunnel_id`` are the encap decision
+    (encap.h encap_and_redirect): nonzero tunnel_ep means the packet
+    leaves encapsulated to that node IP with the source security
+    identity in the tunnel key.  All [B] int32."""
 
     daddr: jnp.ndarray
     dport: jnp.ndarray
     saddr: jnp.ndarray
     sport: jnp.ndarray
     rev_nat: jnp.ndarray
+    tunnel_ep: jnp.ndarray
+    tunnel_id: jnp.ndarray
 
 
 def lb_rev_nat_arrays(lb_tables, saddr, sport, rev_nat_idx):
@@ -140,6 +155,14 @@ def lb_rev_nat_arrays(lb_tables, saddr, sport, rev_nat_idx):
 
 
 class FullTables(NamedTuple):
+    """All device state for the full step.  The tunnel LPM (tun_*) is
+    the device twin of the reference's cilium_tunnel_map (pkg/maps/
+    tunnel): pod-CIDR -> tunnel endpoint node IP.  ``ep_identity`` [E]
+    is each local endpoint slot's own security identity — the SECLABEL
+    the per-endpoint program compiles in (bpf_lxc.c) — stamped into the
+    tunnel key on encap.  All optional: None disables the overlay
+    stage."""
+
     datapath: DatapathTables          # policy + ipcache LPM
     lb: LBTables                      # service tables
     pf_masks: jnp.ndarray             # prefilter deny LPM
@@ -147,16 +170,28 @@ class FullTables(NamedTuple):
     pf_key_b: jnp.ndarray
     pf_value: jnp.ndarray
     pf_plens: jnp.ndarray
+    tun_masks: jnp.ndarray = None     # tunnel map LPM (encap.h)
+    tun_key_a: jnp.ndarray = None
+    tun_key_b: jnp.ndarray = None
+    tun_value: jnp.ndarray = None
+    tun_plens: jnp.ndarray = None
+    ep_identity: jnp.ndarray = None   # [E] local slot -> own identity
 
 
 def full_datapath_step(tables: FullTables, ct, counters: Counters,
                        pkt: FullPacketBatch, now: jnp.ndarray, *,
                        policy_probe: int, lpm_probe: int, pf_probe: int,
-                       lb_probe: int, ct_slots: int, ct_probe: int):
+                       lb_probe: int, ct_slots: int, ct_probe: int,
+                       tun_probe: int = 0):
     """The batched equivalent of the reference's per-packet egress path
     (bpf_lxc.c:432 handle_ipv4_from_lxc): XDP prefilter drop, service
     DNAT (lb4_local), conntrack lookup, ipcache identity resolve, policy
-    verdict for CT_NEW flows, CT entry creation gated on the verdict.
+    verdict for CT_NEW flows, CT entry creation gated on the verdict —
+    plus the overlay plane: ingress packets flagged from_overlay take
+    their source identity from the tunnel key (bpf_overlay.c:151), and
+    allowed egress packets whose destination hits the tunnel map are
+    marked for encap with the endpoint's identity in the tunnel key
+    (encap.h encap_and_redirect, TRACE_TO_OVERLAY).
 
     Returns (verdict [B], event [B], identity [B], ct', counters').
     Verdict: -N drop code / 0 allow / >0 proxy port.
@@ -196,6 +231,12 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                               tables.datapath.lpm_value,
                               tables.datapath.lpm_plens, peer, lpm_probe)
     identity = jnp.where(found, ident, jnp.int32(WORLD_IDENTITY))
+    # Overlay decap: the sending node stamped the source identity into
+    # the tunnel key; it wins over the local ipcache view
+    # (bpf_overlay.c:151 key.tunnel_id -> ipv4_local_delivery secctx).
+    if pkt.from_overlay is not None:
+        decap = (pkt.from_overlay != 0) & (pkt.direction == 0)
+        identity = jnp.where(decap, pkt.tunnel_id, identity)
 
     # 5. Policy verdict (bpf/lib/policy.h __policy_can_access).
     vb = PacketBatch(endpoint=pkt.endpoint, identity=identity,
@@ -240,6 +281,33 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                   jnp.where(verdict < 0, jnp.int32(DROP_POLICY),
                             jnp.where(verdict > 0, jnp.int32(TRACE_TO_PROXY),
                                       jnp.int32(TRACE_TO_LXC)))))
+
+    # 9. Overlay encap (encap.h encap_and_redirect): allowed egress
+    # packets whose (DNAT'd) destination falls in a peer node's pod
+    # CIDR leave encapsulated to that node's tunnel endpoint, carrying
+    # the sending endpoint's own identity (SECLABEL) in the tunnel key.
+    # Proxy-redirected packets go to the proxy first, not the overlay.
+    zero = jnp.zeros_like(verdict)
+    if tun_probe > 0 and tables.tun_key_a is not None:
+        from .events import TRACE_TO_OVERLAY
+        t_hit, t_ep = lpm_lookup(tables.tun_masks, tables.tun_key_a,
+                                 tables.tun_key_b, tables.tun_value,
+                                 tables.tun_plens, daddr, tun_probe)
+        encap = t_hit & (pkt.direction == 1) & (verdict == 0) & ~pf_hit
+        if tables.ep_identity is not None:
+            n_ep = tables.ep_identity.shape[0]
+            src_sec = tables.ep_identity[
+                jnp.clip(pkt.endpoint, 0, n_ep - 1)]
+        else:
+            src_sec = zero
+        tun_ep_out = jnp.where(encap, t_ep, zero)
+        tun_id_out = jnp.where(encap, src_sec, zero)
+        event = jnp.where(encap, jnp.int32(TRACE_TO_OVERLAY), event)
+    else:
+        tun_ep_out = zero
+        tun_id_out = zero
+
     nat = NATResult(daddr=daddr, dport=dport, saddr=nat_saddr,
-                    sport=nat_sport, rev_nat=ct_rev_nat)
+                    sport=nat_sport, rev_nat=ct_rev_nat,
+                    tunnel_ep=tun_ep_out, tunnel_id=tun_id_out)
     return verdict, event, identity, nat, ct, counters

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import base64
 import json
+import queue
 import socket
 import socketserver
 import struct
@@ -98,7 +99,6 @@ class _Conn(socketserver.BaseRequestHandler):
     def setup(self):
         self.server_obj: "KVStoreServer" = self.server.kv_server
         self.store: MemStore = self.server_obj.store
-        self.wlock = threading.Lock()
         # ops delegate to a per-connection InMemoryBackend session, so
         # lease/CAS/lock semantics live in exactly one place
         # (memory.py); this handler only does wire marshaling + watch
@@ -113,11 +113,43 @@ class _Conn(socketserver.BaseRequestHandler):
         # lock_id -> Lock handle
         self.locks: Dict[str, Lock] = {}
         # client-supplied lock_ref bookkeeping for abandoned waits:
-        # refs the client aborted before the grant arrived, and
-        # ref -> lock_id for aborts that race past the grant
+        # refs with an acquisition still in flight, refs the client
+        # aborted before the grant arrived, and ref -> lock_id for
+        # aborts that race past the grant.  aborted_refs only ever
+        # holds refs still in pending_refs, so it cannot leak.
+        self.pending_refs: set = set()
         self.aborted_refs: set = set()
         self.granted_refs: Dict[str, str] = {}
         self._inflight = threading.BoundedSemaphore(MAX_INFLIGHT)
+        # Single-writer outgoing queue: responses and watch events never
+        # contend on the socket, so a watch forwarder stuck behind a
+        # slow consumer cannot stall the reader thread's inline
+        # dispatches (keepalives keep flowing).  A consumer that lets
+        # the queue fill for SEND_TIMEOUT is evicted (connection
+        # closed), like the reference monitor's lossy per-subscriber
+        # queues (monitor/main.go send path).
+        self.out_q: "queue.Queue[Optional[dict]]" = queue.Queue(
+            maxsize=1024)
+        self._writer = threading.Thread(target=self._write_loop,
+                                        daemon=True, name="kv-writer")
+        self._writer.start()
+
+    SEND_TIMEOUT = 5.0
+
+    def _write_loop(self) -> None:
+        while True:
+            try:
+                obj = self.out_q.get(timeout=0.5)
+            except queue.Empty:
+                if self.finished:
+                    return
+                continue
+            if obj is None:
+                return
+            try:
+                send_frame(self.request, obj)
+            except OSError:
+                return
 
     def handle(self):
         self.request.settimeout(None)
@@ -146,11 +178,18 @@ class _Conn(socketserver.BaseRequestHandler):
                 # prompt even while lock threads wait
                 self._dispatch(req, False)
 
-    def _respond(self, resp: dict) -> None:
+    def _respond(self, resp: dict) -> bool:
+        """Enqueue a frame for the writer thread.  A consumer whose
+        queue stays full for SEND_TIMEOUT is evicted."""
         try:
-            send_frame(self.request, resp, self.wlock)
-        except OSError:
-            pass
+            self.out_q.put(resp, timeout=self.SEND_TIMEOUT)
+            return True
+        except queue.Full:
+            try:
+                self.request.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return False
 
     def _dispatch(self, req: dict, holds_slot: bool) -> None:
         rid = req.get("id")
@@ -218,10 +257,20 @@ class _Conn(socketserver.BaseRequestHandler):
         if op == "lock":
             timeout = min(float(req.get("timeout", 30.0)),
                           MAX_LOCK_TIMEOUT)
-            lock = be.lock_path(req["path"], timeout=timeout)
-            lock_id = uuid.uuid4().hex
             lock_ref = req.get("lock_ref")
+            if lock_ref is not None:
+                with self.dlock:
+                    self.pending_refs.add(lock_ref)
+            try:
+                lock = be.lock_path(req["path"], timeout=timeout)
+            except KVLockError:
+                with self.dlock:
+                    self.pending_refs.discard(lock_ref)
+                    self.aborted_refs.discard(lock_ref)
+                raise
+            lock_id = uuid.uuid4().hex
             with self.dlock:
+                self.pending_refs.discard(lock_ref)
                 if self.finished:
                     pass  # fall through: connection died while we waited
                 elif lock_ref is not None and \
@@ -246,7 +295,9 @@ class _Conn(socketserver.BaseRequestHandler):
                 lock_id = self.granted_refs.pop(ref, None)
                 if lock_id is not None:
                     held = self.locks.pop(lock_id, None)
-                else:
+                elif ref in self.pending_refs:
+                    # only mark refs with an acquisition still in
+                    # flight; anything else would leak forever
                     self.aborted_refs.add(ref)
             if held:
                 held.unlock()
@@ -282,12 +333,9 @@ class _Conn(socketserver.BaseRequestHandler):
 
         def forward():
             for ev in watcher:
-                try:
-                    send_frame(self.request,
-                               {"watch_id": watch_id, "typ": ev.typ,
-                                "key": ev.key,
-                                "value_b64": _b64(ev.value)}, self.wlock)
-                except OSError:
+                if not self._respond({"watch_id": watch_id,
+                                      "typ": ev.typ, "key": ev.key,
+                                      "value_b64": _b64(ev.value)}):
                     return
 
         t = threading.Thread(target=forward, daemon=True)
@@ -314,6 +362,11 @@ class _Conn(socketserver.BaseRequestHandler):
             self.locks.clear()
             self.granted_refs.clear()
             self.aborted_refs.clear()
+            self.pending_refs.clear()
+        try:
+            self.out_q.put_nowait(None)  # stop the writer
+        except queue.Full:
+            pass  # writer exits via the finished flag
         for watcher, _t in watches:
             watcher.stop()
         # held locks die with the connection (eager release avoids a

@@ -25,16 +25,34 @@ class NodeManager:
     """Realize node add/update/delete into forwarding state."""
 
     def __init__(self, local_node: str, ipcache: Optional[IPCache] = None,
-                 mode: str = ROUTE_TUNNEL):
+                 mode: str = ROUTE_TUNNEL, datapath=None):
         self.local_node = local_node
         self.mode = mode
         self.ipcache = ipcache
+        # datapath.load_tunnel realizes tunnel_map changes as the
+        # device-resident tunnel LPM the encap stage consumes
+        # (pkg/maps/tunnel SetTunnelEndpoint -> cilium_tunnel_map)
+        self.datapath = datapath
         self._mu = threading.Lock()
         self._nodes: Dict[str, Node] = {}
         # pod CIDR prefix -> tunnel endpoint IP (pkg/maps/tunnel analog)
         self.tunnel_map: Dict[str, str] = {}
         # direct routes: pod CIDR -> nexthop node IP
         self.routes: Dict[str, str] = {}
+
+    def _program_tunnel(self) -> None:
+        """Push the current tunnel map into the datapath (device LPM:
+        pod CIDR -> tunnel endpoint node IP as u32).  Snapshot and
+        apply under one lock hold: concurrent node events (registry
+        watch thread + clustermesh) applying snapshots out of order
+        would leave stale tunnel state programmed."""
+        if self.datapath is None:
+            return
+        from ..compiler.lpm import ipv4_to_u32
+        with self._mu:
+            prefixes = {cidr: int(ipv4_to_u32(ip))
+                        for cidr, ip in self.tunnel_map.items()}
+            self.datapath.load_tunnel(prefixes)
 
     def node_updated(self, node: Node) -> None:
         """Reference: manager.go NodeUpdated — program or refresh the
@@ -59,6 +77,7 @@ class NodeManager:
             self.ipcache.upsert(node.ipv4_alloc_cidr, RESERVED_WORLD,
                                 SOURCE_KVSTORE, host_ip=node_ip,
                                 metadata=f"node:{node.full_name}")
+        self._program_tunnel()
 
     def node_deleted(self, full_name: str) -> None:
         """Reference: manager.go NodeDeleted — tear down routes/tunnel."""
@@ -70,6 +89,7 @@ class NodeManager:
                 self._remove_cidr_locked(node.ipv4_alloc_cidr)
         if self.ipcache is not None and node.ipv4_alloc_cidr:
             self.ipcache.delete(node.ipv4_alloc_cidr, SOURCE_KVSTORE)
+        self._program_tunnel()
 
     def _remove_cidr_locked(self, cidr: str) -> None:
         self.tunnel_map.pop(cidr, None)

@@ -1,0 +1,170 @@
+"""Subprocess agent for the cross-node overlay/tunnel e2e test.
+
+Two of these processes share a TCP kvstore.  Each runs a full Daemon,
+registers its node (pod CIDR + node IP) in the node registry, and
+creates one endpoint.  Node discovery programs each side's device
+tunnel LPM via the NodeManager.
+
+Role "sender": waits until the peer node appears, then processes an
+egress packet from its endpoint to the peer's pod IP and prints the
+encap decision — the tunnel endpoint (must be the peer's node IP) and
+the tunnel identity (must be the sending endpoint's security identity).
+
+Role "receiver": prints readiness, then reads one JSON "wire packet"
+per line from stdin — {saddr, daddr, dport, tunnel_id} — and processes
+it as from-overlay ingress traffic into its endpoint, printing the
+verdict.  Its policy allows only the sender's label set, and its
+ipcache deliberately has NO entry for the sender's pod IP in one of the
+scenarios, so an allow verdict proves the identity was taken from the
+tunnel key (bpf_overlay.c:151), not from an ipcache lookup.
+
+Usage: python tests/overlay_proc.py <kv_port> <node> <role>
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from cilium_tpu.daemon import Daemon  # noqa: E402
+from cilium_tpu.datapath.engine import make_full_batch  # noqa: E402
+from cilium_tpu.datapath.events import TRACE_TO_OVERLAY  # noqa: E402
+from cilium_tpu.kvstore.remote import RemoteBackend  # noqa: E402
+from cilium_tpu.node import Node, NodeAddress  # noqa: E402
+from cilium_tpu.policy.jsonio import rules_from_json  # noqa: E402
+from cilium_tpu.utils.option import DaemonConfig  # noqa: E402
+
+
+def u32_to_ipv4(v: int) -> str:
+    v = int(v) & 0xFFFFFFFF
+    return ".".join(str((v >> s) & 0xFF) for s in (24, 16, 8, 0))
+
+SENDER_CIDR, RECEIVER_CIDR = "10.60.1.0/24", "10.60.2.0/24"
+SENDER_NODE_IP, RECEIVER_NODE_IP = "192.168.7.1", "192.168.7.2"
+SENDER_POD, RECEIVER_POD = "10.60.1.9", "10.60.2.9"
+
+
+def wait_for(pred, timeout=15.0):
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def main() -> None:
+    kv_port = int(sys.argv[1])
+    node_name = sys.argv[2]
+    role = sys.argv[3]
+    is_sender = role == "sender"
+
+    kv = RemoteBackend(port=kv_port, lease_ttl=10.0)
+    d = Daemon(config=DaemonConfig(), kvstore_backend=kv,
+               node_name=node_name)
+    me = Node(name=node_name,
+              addresses=[NodeAddress("InternalIP",
+                                     SENDER_NODE_IP if is_sender
+                                     else RECEIVER_NODE_IP)],
+              ipv4_alloc_cidr=SENDER_CIDR if is_sender else RECEIVER_CIDR)
+    d.node_registry.register_local(me)
+
+    try:
+        if is_sender:
+            run_sender(d)
+        else:
+            run_receiver(d)
+    finally:
+        d.shutdown()
+        kv.close()
+
+
+def run_sender(d: Daemon) -> None:
+    ep = d.endpoint_create(1, ipv4=SENDER_POD,
+                           labels=["k8s:app=overlay-client"])
+    # an explicit allow-all egress rule keeps the verdict deterministic
+    rev = d.policy_add(rules_from_json(json.dumps([
+        {"endpointSelector": {"matchLabels": {"app": "overlay-client"}},
+         "egress": [{"toEntities": ["all"]}]}])))
+    d.wait_for_policy_revision(rev)
+    assert wait_for(lambda: d.datapath.tunnel_prefixes.get(RECEIVER_CIDR)
+                    is not None), "peer node never appeared"
+
+    batch = make_full_batch(endpoint=[ep.table_slot],
+                            saddr=[SENDER_POD], daddr=[RECEIVER_POD],
+                            sport=[40001], dport=[8080], direction=[1])
+    verdict, event, identity, nat = d.datapath.process(batch, now=1000)
+    out = {
+        "verdict": int(np.asarray(verdict)[0]),
+        "event": int(np.asarray(event)[0]),
+        "to_overlay": int(np.asarray(event)[0]) == TRACE_TO_OVERLAY,
+        "tunnel_ep": u32_to_ipv4(
+            np.asarray(nat.tunnel_ep).astype(np.uint32)[0]),
+        "tunnel_id": int(np.asarray(nat.tunnel_id)[0]),
+        "endpoint_identity": ep.security_identity,
+        "saddr": SENDER_POD, "daddr": RECEIVER_POD, "dport": 8080,
+    }
+    print(json.dumps(out), flush=True)
+
+
+def run_receiver(d: Daemon) -> None:
+    ep = d.endpoint_create(2, ipv4=RECEIVER_POD,
+                           labels=["k8s:app=overlay-server"])
+    # L3 ingress policy: only peers with the overlay-client label may
+    # reach overlay-server.  The sender's identity for that label set
+    # is shared cluster-wide via the distributed allocator.
+    rev = d.policy_add(rules_from_json(json.dumps([{
+        "endpointSelector": {"matchLabels": {"app": "overlay-server"}},
+        "ingress": [{"fromEndpoints": [
+            {"matchLabels": {"app": "overlay-client"}}]}],
+    }])))
+    d.wait_for_policy_revision(rev)
+    print(json.dumps({"ready": True,
+                      "endpoint_identity": ep.security_identity}),
+          flush=True)
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        wire = json.loads(line)
+        if wire.get("op") == "quit":
+            return
+        # a freshly allocated remote identity triggers an async policy
+        # recompute (identity-change regen); wait for it to land before
+        # classifying, like the reference's revision wait after
+        # TriggerPolicyUpdates.  Reserved identities (< 256) are static.
+        if wire["tunnel_id"] >= 256:
+            wait_for(lambda: d.identity_allocator.lookup_by_id(
+                wire["tunnel_id"]) is not None)
+            # force the recompute synchronously so the verdict below is
+            # deterministic (the async identity-change trigger races)
+            d.endpoints.regenerate_all("wire-packet")
+            d.endpoints.wait_for_quiesce()
+        batch = make_full_batch(
+            endpoint=[ep.table_slot],
+            saddr=[wire["saddr"]], daddr=[wire["daddr"]],
+            sport=[wire.get("sport", 40001)], dport=[wire["dport"]],
+            direction=[0],
+            from_overlay=[1], tunnel_id=[wire["tunnel_id"]])
+        verdict, event, identity, _nat = d.datapath.process(batch,
+                                                            now=2000)
+        print(json.dumps({
+            "verdict": int(np.asarray(verdict)[0]),
+            "identity_used": int(np.asarray(identity)[0]),
+            "ipcache_has_sender": d.ipcache.lookup_by_ip(wire["saddr"])
+            is not None,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

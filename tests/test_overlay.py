@@ -1,0 +1,243 @@
+"""Overlay/tunnel datapath: encap with identity in the tunnel key,
+decap deriving identity from it.
+
+Reference semantics being mirrored:
+  * bpf/lib/encap.h encap_and_redirect — egress packets to a remote
+    pod CIDR leave encapsulated to the peer node's tunnel endpoint with
+    the sending endpoint's security identity as the tunnel id, emitting
+    TRACE_TO_OVERLAY;
+  * bpf/bpf_overlay.c:151 from-overlay — decapsulated packets take
+    their source identity from the tunnel key, not the ipcache;
+  * pkg/maps/tunnel — node manager programs pod-CIDR -> node-IP.
+
+The e2e test runs two real agent processes sharing a TCP kvstore: node
+discovery programs the sender's device tunnel LPM, the sender's
+datapath produces the encap decision, and the wire packet is fed to
+the receiver's datapath as from-overlay traffic whose verdict uses the
+tunnel-carried identity (a wrong tunnel identity is denied even though
+the receiver's ipcache would have allowed the sender's address).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.compiler.lpm import compile_lpm, ipv4_to_u32
+from cilium_tpu.compiler.policy_tables import compile_endpoints
+from cilium_tpu.datapath.engine import Datapath, make_full_batch
+from cilium_tpu.datapath.events import (DROP_POLICY, TRACE_TO_LXC,
+                                        TRACE_TO_OVERLAY)
+from cilium_tpu.kvstore.server import KVStoreServer
+from cilium_tpu.policy.mapstate import (EGRESS, INGRESS, PolicyKey,
+                                        PolicyMapState, PolicyMapStateEntry)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _dp_with_tunnel():
+    """One endpoint (slot 0, identity 5001) allowed egress to identity
+    300 on 8080; tunnel map: 10.2.0.0/16 -> 192.168.0.2."""
+    st = PolicyMapState()
+    st[PolicyKey(identity=300, dest_port=8080, nexthdr=6,
+                 direction=EGRESS)] = PolicyMapStateEntry()
+    st[PolicyKey(identity=4242, dest_port=80, nexthdr=6,
+                 direction=INGRESS)] = PolicyMapStateEntry()
+    dp = Datapath(ct_slots=1 << 8, ct_probe=4)
+    dp.load_policy([st], revision=1,
+                   ipcache_prefixes={"10.2.0.0/16": 300,
+                                     "10.1.0.0/16": 301})
+    dp.load_tunnel({"10.2.0.0/16": ipv4_to_u32("192.168.0.2")})
+    dp.set_endpoint_identity(0, 5001)
+    return dp
+
+
+def test_egress_encap_carries_identity_in_tunnel_key():
+    dp = _dp_with_tunnel()
+    batch = make_full_batch(endpoint=[0, 0], saddr=["10.1.0.5"] * 2,
+                            daddr=["10.2.3.4", "10.1.0.9"],
+                            sport=[1111, 1112], dport=[8080, 8080],
+                            direction=[1, 1])
+    verdict, event, identity, nat = dp.process(batch, now=100)
+    verdict = np.asarray(verdict)
+    event = np.asarray(event)
+    # packet 0: allowed egress to the remote pod CIDR -> encap to the
+    # peer node with the endpoint's own identity in the tunnel key
+    assert verdict[0] == 0
+    assert event[0] == TRACE_TO_OVERLAY
+    assert np.asarray(nat.tunnel_ep).astype(np.uint32)[0] == \
+        ipv4_to_u32("192.168.0.2")
+    assert np.asarray(nat.tunnel_id)[0] == 5001
+    # packet 1: local destination (no tunnel entry) -> no encap; it is
+    # dropped by policy (10.1/16 resolves to identity 301, not allowed)
+    assert np.asarray(nat.tunnel_ep)[1] == 0
+    assert np.asarray(nat.tunnel_id)[1] == 0
+    assert event[1] != TRACE_TO_OVERLAY
+
+
+def test_denied_or_proxied_egress_does_not_encap():
+    st = PolicyMapState()
+    # proxy redirect for 300:9090
+    st[PolicyKey(identity=300, dest_port=9090, nexthdr=6,
+                 direction=EGRESS)] = PolicyMapStateEntry(proxy_port=12345)
+    dp = Datapath(ct_slots=1 << 8, ct_probe=4)
+    dp.load_policy([st], revision=1,
+                   ipcache_prefixes={"10.2.0.0/16": 300})
+    dp.load_tunnel({"10.2.0.0/16": ipv4_to_u32("192.168.0.2")})
+    dp.set_endpoint_identity(0, 5001)
+    batch = make_full_batch(endpoint=[0, 0], saddr=["10.1.0.5"] * 2,
+                            daddr=["10.2.3.4", "10.2.3.4"],
+                            sport=[2222, 2223], dport=[9090, 7],
+                            direction=[1, 1])
+    verdict, event, identity, nat = dp.process(batch, now=100)
+    verdict = np.asarray(verdict)
+    # packet 0 redirects to the proxy: not encapped here (the proxied
+    # flow re-enters the datapath after L7); packet 1 is denied
+    assert verdict[0] == 12345
+    assert verdict[1] < 0
+    assert (np.asarray(nat.tunnel_ep) == 0).all()
+
+
+def test_decap_identity_from_tunnel_key_beats_ipcache():
+    """from-overlay ingress: the tunnel id decides the verdict even
+    when the ipcache would resolve the address differently
+    (bpf_overlay.c:151)."""
+    dp = _dp_with_tunnel()
+    # ingress allowed only from identity 4242 on port 80.  The source
+    # address resolves to 301 via ipcache — which is NOT allowed — so
+    # an allow can only come from the tunnel-carried identity.
+    batch = make_full_batch(
+        endpoint=[0, 0], saddr=["10.1.0.7", "10.1.0.7"],
+        daddr=["10.2.9.9", "10.2.9.9"], sport=[3333, 3334],
+        dport=[80, 80], direction=[0, 0],
+        from_overlay=[1, 1], tunnel_id=[4242, 2])
+    verdict, event, identity, _nat = dp.process(batch, now=100)
+    verdict = np.asarray(verdict)
+    identity = np.asarray(identity)
+    assert identity[0] == 4242 and verdict[0] == 0
+    # wrong tunnel identity (WORLD): denied, though same source addr
+    assert identity[1] == 2 and verdict[1] < 0
+
+
+def test_non_overlay_batch_unchanged():
+    """Batches without overlay fields behave exactly as before."""
+    dp = _dp_with_tunnel()
+    batch = make_full_batch(endpoint=[0], saddr=["10.1.0.7"],
+                            daddr=["10.9.9.9"], sport=[4444],
+                            dport=[80], direction=[0])
+    assert batch.from_overlay is None
+    verdict, event, identity, nat = dp.process(batch, now=100)
+    # identity resolves via ipcache as before (10.1/16 -> 301), which
+    # the ingress policy (4242:80 only) denies
+    assert np.asarray(identity)[0] == 301
+    assert np.asarray(verdict)[0] < 0
+    assert np.asarray(nat.tunnel_ep)[0] == 0
+
+
+def test_node_manager_programs_device_tunnel_table():
+    from cilium_tpu.node import Node, NodeAddress, NodeManager
+    dp = Datapath(ct_slots=1 << 8, ct_probe=4)
+    st = PolicyMapState()
+    st[PolicyKey(identity=300, dest_port=8080, nexthdr=6,
+                 direction=EGRESS)] = PolicyMapStateEntry()
+    dp.load_policy([st], revision=1,
+                   ipcache_prefixes={"10.2.0.0/16": 300})
+    dp.set_endpoint_identity(0, 7007)
+    mgr = NodeManager("default/local", datapath=dp)
+    mgr.node_updated(Node(name="peer",
+                          addresses=[NodeAddress("InternalIP",
+                                                 "192.168.44.2")],
+                          ipv4_alloc_cidr="10.2.0.0/16"))
+    assert list(dp.tunnel_prefixes) == ["10.2.0.0/16"]
+    assert (dp.tunnel_prefixes["10.2.0.0/16"] & 0xFFFFFFFF) == \
+        ipv4_to_u32("192.168.44.2")
+    batch = make_full_batch(endpoint=[0], saddr=["10.1.0.5"],
+                            daddr=["10.2.3.4"], sport=[5555],
+                            dport=[8080], direction=[1])
+    _v, event, _i, nat = dp.process(batch, now=100)
+    assert np.asarray(event)[0] == TRACE_TO_OVERLAY
+    assert np.asarray(nat.tunnel_id)[0] == 7007
+    # node deletion tears the tunnel entry down
+    mgr.node_deleted("default/peer")
+    assert dp.tunnel_prefixes == {}
+    _v, event, _i, nat = dp.process(batch, now=101)
+    assert np.asarray(nat.tunnel_ep)[0] == 0
+
+
+# --------------------------------------------------- cross-process e2e
+
+def _read_json_line(stream, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = stream.readline()
+        if line:
+            return json.loads(line)
+    raise TimeoutError("no JSON line from subprocess")
+
+
+def test_two_node_overlay_exchange():
+    """Two agent processes, one kvstore: the sender encaps with its
+    identity in the tunnel key; the receiver's verdict follows the
+    tunnel identity — allowed for the real identity, denied for a
+    forged WORLD identity on the very same addresses."""
+    server = KVStoreServer(port=0).start()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    try:
+        recv = subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "overlay_proc.py"),
+             str(server.port), "node-b", "receiver"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            env=env)
+        procs.append(recv)
+        ready = _read_json_line(recv.stdout)
+        assert ready["ready"]
+
+        send = subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "overlay_proc.py"),
+             str(server.port), "node-a", "sender"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(send)
+        wire = _read_json_line(send.stdout)
+        # the sender encapped: tunnel endpoint is the receiver's node
+        # IP, tunnel id is the sending endpoint's identity
+        assert wire["to_overlay"], wire
+        assert wire["tunnel_ep"] == "192.168.7.2"
+        assert wire["tunnel_id"] == wire["endpoint_identity"] > 0
+
+        # deliver the wire packet to the receiver: allowed via the
+        # tunnel-carried identity
+        recv.stdin.write(json.dumps({
+            "saddr": wire["saddr"], "daddr": wire["daddr"],
+            "dport": 80, "tunnel_id": wire["tunnel_id"]}) + "\n")
+        recv.stdin.flush()
+        out = _read_json_line(recv.stdout)
+        assert out["identity_used"] == wire["tunnel_id"]
+        assert out["verdict"] == 0, out
+
+        # forged tunnel identity (WORLD) on the same addresses: denied,
+        # even though the receiver's ipcache knows the sender's address.
+        # Fresh source port — the first packet's allowed flow is in the
+        # receiver's conntrack, and established flows (correctly) keep
+        # their CT verdict without re-running policy.
+        recv.stdin.write(json.dumps({
+            "saddr": wire["saddr"], "daddr": wire["daddr"],
+            "sport": 40002, "dport": 80, "tunnel_id": 2}) + "\n")
+        recv.stdin.flush()
+        out2 = _read_json_line(recv.stdout)
+        assert out2["identity_used"] == 2
+        assert out2["verdict"] < 0, out2
+
+        recv.stdin.write(json.dumps({"op": "quit"}) + "\n")
+        recv.stdin.flush()
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        server.shutdown()
