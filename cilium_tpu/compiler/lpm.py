@@ -7,8 +7,8 @@ classic "iterate distinct prefix lengths, longest first, masked exact
 lookup per length" scheme vectorizes perfectly: P ≤ 40 lengths means a
 [B, P] batch of hash lookups, all gathers.
 
-IPv4 addresses are uint32; IPv6 is folded to a uint64 prefix pair (hi/lo)
-packed into the two key words.
+IPv4 addresses are uint32 (CompiledLPM, one key word); IPv6 addresses are
+four uint32 words compared in full — CompiledLPM6 below, no folding.
 """
 
 from __future__ import annotations
@@ -51,8 +51,8 @@ class CompiledLPM:
 
 def compile_lpm(prefixes: Dict[str, int],
                 min_slots: int = 8) -> CompiledLPM:
-    """{cidr_string: value} -> CompiledLPM (IPv4 only; v6 handled by the
-    ipcache module with paired words)."""
+    """{cidr_string: value} -> CompiledLPM (IPv4 only; v6 goes through
+    compile_lpm6's four-word tables)."""
     by_len: Dict[int, Dict[Tuple[int, int], int]] = {}
     for cidr, val in prefixes.items():
         net = ipaddress.ip_network(cidr, strict=False)
@@ -102,3 +102,122 @@ def oracle_lpm(prefixes: Dict[str, int], ip: str) -> int:
 
 def ipv4_to_u32(ip: str) -> int:
     return int(ipaddress.IPv4Address(ip))
+
+
+# ---------------------------------------------------------------------------
+# IPv6: 128-bit addresses as four uint32 words
+# ---------------------------------------------------------------------------
+#
+# The reference runs a second LPM trie for v6 (bpf/lib/maps.h ipcache
+# keys are family-tagged; bpf_lxc.c:114 ipv6_l3_from_lxc).  On TPU the
+# v4 scheme generalizes directly: per-prefix-length masked EXACT match,
+# with the address as four 32-bit lanes instead of one.  The lookup
+# compares all four words — no folding, full 128-bit correctness.
+
+def ipv6_to_words(ip: str) -> Tuple[int, int, int, int]:
+    """Big-endian uint32 words (w0 = most significant)."""
+    v = int(ipaddress.IPv6Address(ip))
+    return ((v >> 96) & 0xFFFFFFFF, (v >> 64) & 0xFFFFFFFF,
+            (v >> 32) & 0xFFFFFFFF, v & 0xFFFFFFFF)
+
+
+def _mask128_words(plen: int) -> Tuple[int, int, int, int]:
+    m = 0 if plen == 0 else \
+        (((1 << plen) - 1) << (128 - plen)) & ((1 << 128) - 1)
+    return ((m >> 96) & 0xFFFFFFFF, (m >> 64) & 0xFFFFFFFF,
+            (m >> 32) & 0xFFFFFFFF, m & 0xFFFFFFFF)
+
+
+def _u32s_to_i32(arr) -> np.ndarray:
+    return np.asarray(arr, np.uint32).view(np.int32)
+
+
+@dataclass
+class CompiledLPM6:
+    """Stacked per-prefix-length tables for IPv6 (descending lengths).
+
+    k0..k3: [P, S] masked address words; kb: [P, S] occupancy word
+    (plen<<1|1, 0 = empty); value: [P, S] payload; masks: [P, 4]."""
+
+    prefix_lens: np.ndarray  # [P] int32, descending
+    masks: np.ndarray        # [P, 4] int32
+    k0: np.ndarray
+    k1: np.ndarray
+    k2: np.ndarray
+    k3: np.ndarray
+    kb: np.ndarray
+    value: np.ndarray
+    max_probe: int
+    slots: int
+
+    def entry_count(self) -> int:
+        return int((self.kb != 0).sum())
+
+
+def _hash6(w0, w1, w2, w3, occ):
+    """Host twin of ops.lpm_ops._hash6_jnp — keep in lockstep."""
+    from .hashtab import hash_mix
+    return hash_mix(hash_mix(np.uint32(w0), np.uint32(w1)),
+                    hash_mix(np.uint32(w2) ^ np.uint32(occ),
+                             np.uint32(w3)))
+
+
+def compile_lpm6(prefixes: Dict[str, int],
+                 min_slots: int = 8) -> CompiledLPM6:
+    """{v6_cidr: value} -> CompiledLPM6."""
+    by_len: Dict[int, Dict[Tuple[int, int, int, int], int]] = {}
+    for cidr, val in prefixes.items():
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != 6:
+            raise ValueError(f"compile_lpm6 is IPv6-only, got {cidr}")
+        mw = _mask128_words(net.prefixlen)
+        aw = ipv6_to_words(str(net.network_address))
+        key = tuple(a & m for a, m in zip(aw, mw))
+        by_len.setdefault(net.prefixlen, {})[key] = val
+    plens = sorted(by_len, reverse=True)
+    if not plens:
+        z = lambda: np.zeros((0, 8), np.int32)
+        return CompiledLPM6(prefix_lens=np.zeros(0, np.int32),
+                            masks=np.zeros((0, 4), np.int32),
+                            k0=z(), k1=z(), k2=z(), k3=z(), kb=z(),
+                            value=z(), max_probe=1, slots=8)
+    # size every per-length table to the same power-of-two slot count
+    n_max = max(len(by_len[p]) for p in plens)
+    slots = min_slots
+    while slots < 2 * n_max:
+        slots *= 2
+    max_probe = 1
+    P = len(plens)
+    k0 = np.zeros((P, slots), np.int32)
+    k1 = np.zeros((P, slots), np.int32)
+    k2 = np.zeros((P, slots), np.int32)
+    k3 = np.zeros((P, slots), np.int32)
+    kb = np.zeros((P, slots), np.int32)
+    value = np.zeros((P, slots), np.int32)
+    for i, p in enumerate(plens):
+        occ = (p << 1) | 1
+        for (w0, w1, w2, w3), val in by_len[p].items():
+            h = int(_hash6(w0, w1, w2, w3, occ)) & (slots - 1)
+            probe = 0
+            while kb[i, (h + probe) % slots] != 0:
+                probe += 1
+                if probe >= slots:
+                    raise RuntimeError("lpm6 table overflow")
+            s = (h + probe) % slots
+            k0[i, s] = np.uint32(w0).view(np.int32)
+            k1[i, s] = np.uint32(w1).view(np.int32)
+            k2[i, s] = np.uint32(w2).view(np.int32)
+            k3[i, s] = np.uint32(w3).view(np.int32)
+            kb[i, s] = occ
+            value[i, s] = np.int32(val)
+            max_probe = max(max_probe, probe + 1)
+    masks = np.stack([_u32s_to_i32(_mask128_words(p)) for p in plens])
+    return CompiledLPM6(
+        prefix_lens=np.asarray(plens, np.int32), masks=masks,
+        k0=k0, k1=k1, k2=k2, k3=k3, kb=kb, value=value,
+        max_probe=max_probe, slots=slots)
+
+
+def ipv6_batch_words(ips: Sequence[str]) -> np.ndarray:
+    """[B, 4] int32 word array from dotted v6 strings."""
+    return _u32s_to_i32([ipv6_to_words(ip) for ip in ips])

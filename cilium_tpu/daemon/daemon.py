@@ -140,7 +140,7 @@ class Daemon:
         # ipcache churn -> datapath LPM reload, debounced
         self._lpm_trigger = Trigger(
             lambda _r: self.datapath.load_ipcache(
-                self.ipcache.to_lpm_prefixes()),
+                *self.ipcache.to_lpm_prefix_families()),
             min_interval=0.01, name="ipcache-lpm")
         self.ipcache.add_listener(
             lambda *_a: self._lpm_trigger.trigger("ipcache"), replay=False)

@@ -17,13 +17,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..compiler.lpm import CompiledLPM, compile_lpm
+from ..compiler.lpm import (CompiledLPM, CompiledLPM6, compile_lpm,
+                            compile_lpm6)
 from ..compiler.policy_tables import CompiledPolicy, compile_endpoints
 from ..policy.mapstate import PolicyMapState
 from .conntrack import ConntrackTable, make_ct_state
 from .lb import CompiledLB, LoadBalancer, Service, compile_lb
-from .pipeline import (DatapathTables, FullPacketBatch, FullTables,
-                       build_tables, full_datapath_step)
+from .pipeline import (DatapathTables, FullPacketBatch, FullPacketBatch6,
+                       FullTables, FullTables6, build_tables,
+                       full_datapath_step, full_datapath_step6,
+                       lpm6_tables)
 from .prefilter import PreFilter
 from .verdict import Counters
 
@@ -46,8 +49,11 @@ class Datapath:
         self.prefilter = PreFilter()
         self.lb = LoadBalancer()
         self.ct = ConntrackTable(slots=ct_slots, max_probe=ct_probe)
+        # separate v6 CT table (the reference keeps ct6 apart from ct4)
+        self.ct6 = ConntrackTable(slots=ct_slots, max_probe=ct_probe)
         self.compiled_policy: Optional[CompiledPolicy] = None
         self.compiled_ipcache: Optional[CompiledLPM] = None
+        self.compiled_ipcache6: Optional[CompiledLPM6] = None
         # tunnel map (pkg/maps/tunnel): pod CIDR -> tunnel endpoint u32,
         # programmed by the NodeManager on node add/delete
         self.tunnel_prefixes: Dict[str, int] = {}
@@ -59,6 +65,8 @@ class Datapath:
         self.revision = 0
         self._step = None
         self._tables: Optional[FullTables] = None
+        self._step6 = None
+        self._tables6: Optional[FullTables6] = None
         # incremental mode: policy tensors owned by a DeviceTableManager
         # (endpoint/tables.py); row syncs swap tensors without re-jit
         self._table_mgr = None
@@ -114,11 +122,22 @@ class Datapath:
             dp = self._tables.datapath._replace(
                 key_id=key_id, key_meta=key_meta, value=value)
             self._tables = self._tables._replace(datapath=dp)
+            if self._tables6 is not None:
+                self._tables6 = self._tables6._replace(
+                    key_id=key_id, key_meta=key_meta, value=value)
             return False
 
-    def load_ipcache(self, prefixes: Dict[str, int]) -> None:
+    def load_ipcache(self, prefixes: Dict[str, int],
+                     prefixes6: Optional[Dict[str, int]] = None) -> None:
         with self._lock:
             self.compiled_ipcache = compile_lpm(prefixes)
+            if prefixes6 is not None:
+                self.compiled_ipcache6 = compile_lpm6(prefixes6)
+            self._rebuild()
+
+    def load_ipcache6(self, prefixes6: Dict[str, int]) -> None:
+        with self._lock:
+            self.compiled_ipcache6 = compile_lpm6(prefixes6)
             self._rebuild()
 
     def load_tunnel(self, prefixes: Dict[str, int]) -> None:
@@ -222,6 +241,24 @@ class Datapath:
             tun_probe=tun_probe),
             donate_argnums=(1, 2))
 
+        # v6 twin: shares the (family-agnostic) policy tensors, runs
+        # the 4-word LPMs for prefilter/ipcache and its own CT table.
+        ipc6 = self.compiled_ipcache6 if self.compiled_ipcache6 \
+            is not None else compile_lpm6({})
+        pf6 = self.prefilter._compiled6
+        if pf6 is None or pf6.entry_count() == 0:
+            pf6 = compile_lpm6({})
+        self._tables6 = FullTables6(
+            key_id=dp.key_id, key_meta=dp.key_meta, value=dp.value,
+            ipcache6=lpm6_tables(ipc6), pf6=lpm6_tables(pf6))
+        self._step6 = jax.jit(functools.partial(
+            full_datapath_step6,
+            policy_probe=policy_probe,
+            lpm6_probe=max(1, ipc6.max_probe),
+            pf6_probe=max(1, pf6.max_probe),
+            ct_slots=self.ct6.slots, ct_probe=self.ct6.max_probe),
+            donate_argnums=(1, 2))
+
     # -- the hot path --------------------------------------------------------
 
     def process(self, pkt: FullPacketBatch, now: Optional[int] = None):
@@ -236,12 +273,25 @@ class Datapath:
                 jnp.int32(now if now is not None else int(time.time())))
             return verdict, event, identity, nat
 
+    def process6(self, pkt: FullPacketBatch6,
+                 now: Optional[int] = None):
+        """Classify a v6 batch (bpf_lxc.c:745 ipv6_policy path).
+        Returns (verdict, event, identity)."""
+        with self._lock:
+            if self._step6 is None:
+                raise RuntimeError("no policy loaded")
+            (verdict, event, identity,
+             self.ct6.state, self.counters) = self._step6(
+                self._tables6, self.ct6.state, self.counters, pkt,
+                jnp.int32(now if now is not None else int(time.time())))
+            return verdict, event, identity
+
     # -- maintenance ---------------------------------------------------------
 
     def gc(self, now: Optional[int] = None) -> int:
         with self._lock:
-            return self.ct.gc(now if now is not None
-                              else int(time.time()))
+            ts = now if now is not None else int(time.time())
+            return self.ct.gc(ts) + self.ct6.gc(ts)
 
 
 def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
@@ -273,3 +323,37 @@ def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
         direction=arr(direction, 1), tcp_flags=arr(tcp_flags, 0x02),
         length=arr(length, 100), is_fragment=arr(is_fragment, 0),
         **overlay_fields)
+
+
+def make_full_batch6(endpoint, saddr, daddr, sport, dport, proto=None,
+                     direction=None, tcp_flags=None, length=None,
+                     is_fragment=None, from_overlay=None,
+                     tunnel_id=None) -> FullPacketBatch6:
+    """v6 batch builder: saddr/daddr accept v6 strings or [B, 4] int32
+    word arrays."""
+    n = len(np.asarray(endpoint))
+    arr = lambda x, d: jnp.asarray(np.asarray(
+        x if x is not None else np.full(n, d), np.int32))
+
+    def addr6(x):
+        a = np.asarray(x)
+        if a.dtype.kind in ("U", "S", "O"):
+            from ..compiler.lpm import ipv6_batch_words
+            return jnp.asarray(ipv6_batch_words([str(s)
+                                                 for s in a.ravel()]))
+        if a.dtype == np.uint32:
+            a = a.view(np.int32)
+        assert a.ndim == 2 and a.shape[1] == 4, "v6 addrs are [B, 4]"
+        return jnp.asarray(a.astype(np.int32)
+                           if a.dtype != np.int32 else a)
+
+    overlay_fields = {}
+    if from_overlay is not None or tunnel_id is not None:
+        overlay_fields = dict(from_overlay=arr(from_overlay, 0),
+                              tunnel_id=arr(tunnel_id, 0))
+    return FullPacketBatch6(
+        endpoint=arr(endpoint, 0), saddr=addr6(saddr),
+        daddr=addr6(daddr), sport=arr(sport, 0), dport=arr(dport, 0),
+        proto=arr(proto, 6), direction=arr(direction, 1),
+        tcp_flags=arr(tcp_flags, 0x02), length=arr(length, 100),
+        is_fragment=arr(is_fragment, 0), **overlay_fields)

@@ -311,3 +311,159 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                     sport=nat_sport, rev_nat=ct_rev_nat,
                     tunnel_ep=tun_ep_out, tunnel_id=tun_id_out)
     return verdict, event, identity, nat, ct, counters
+
+
+# ---------------------------------------------------------------------------
+# IPv6 path (bpf_lxc.c:114 ipv6_l3_from_lxc, :745 ipv6_policy)
+# ---------------------------------------------------------------------------
+#
+# Addresses are [B, 4] int32 word arrays (big-endian u32 words).  The
+# policy verdict tables are family-agnostic (identity x port x proto),
+# so the v6 path shares them — only the address-keyed stages differ:
+# prefilter and ipcache run the 4-word LPM (full 128-bit compare).
+#
+# Conntrack: the reference keeps a separate ct6 map with full 128-bit
+# tuple keys.  Here the v6 CT is a SEPARATE CT table whose two address
+# words hold 32-bit mixes of the 128-bit addresses (fold6 below) — a
+# deliberate TPU trade: the CT hot loop stays the same 4-word-key
+# scatter/gather kernel for both families instead of doubling gather
+# volume.  Two distinct v6 flows alias only if both address folds AND
+# the exact port pair AND proto/direction all collide (~2^-64 per flow
+# pair); the effect of an alias is one shared CT entry (stale
+# timeout/flag sharing), the same class of benign interference as the
+# reference's documented CT races — not a policy bypass, because policy
+# runs on the ipcache identity, which uses full 128-bit compares.
+
+class FullPacketBatch6(NamedTuple):
+    """v6 wire metadata; addresses [B, 4], everything else [B] int32."""
+
+    endpoint: jnp.ndarray
+    saddr: jnp.ndarray       # [B, 4]
+    daddr: jnp.ndarray       # [B, 4]
+    sport: jnp.ndarray
+    dport: jnp.ndarray
+    proto: jnp.ndarray
+    direction: jnp.ndarray
+    tcp_flags: jnp.ndarray
+    length: jnp.ndarray
+    is_fragment: jnp.ndarray
+    from_overlay: jnp.ndarray = None
+    tunnel_id: jnp.ndarray = None
+
+
+class LPM6Tables(NamedTuple):
+    masks: jnp.ndarray   # [P, 4]
+    k0: jnp.ndarray      # [P, S]
+    k1: jnp.ndarray
+    k2: jnp.ndarray
+    k3: jnp.ndarray
+    kb: jnp.ndarray
+    value: jnp.ndarray
+    plens: jnp.ndarray   # [P]
+
+
+class FullTables6(NamedTuple):
+    key_id: jnp.ndarray      # shared policy tables [E, S]
+    key_meta: jnp.ndarray
+    value: jnp.ndarray
+    ipcache6: LPM6Tables
+    pf6: LPM6Tables
+
+
+def lpm6_tables(c) -> LPM6Tables:
+    """CompiledLPM6 -> device tables."""
+    return LPM6Tables(masks=jnp.asarray(c.masks), k0=jnp.asarray(c.k0),
+                      k1=jnp.asarray(c.k1), k2=jnp.asarray(c.k2),
+                      k3=jnp.asarray(c.k3), kb=jnp.asarray(c.kb),
+                      value=jnp.asarray(c.value),
+                      plens=jnp.asarray(c.prefix_lens))
+
+
+def fold6(words: jnp.ndarray) -> jnp.ndarray:
+    """[B, 4] -> [B] 32-bit mix (CT key fold; see module comment)."""
+    from ..ops.hashtab_ops import hash_mix_jnp
+    return hash_mix_jnp(hash_mix_jnp(words[:, 0], words[:, 1]),
+                        hash_mix_jnp(words[:, 2], words[:, 3]))
+
+
+def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
+                        pkt: FullPacketBatch6, now: jnp.ndarray, *,
+                        policy_probe: int, lpm6_probe: int,
+                        pf6_probe: int, ct_slots: int, ct_probe: int):
+    """The v6 twin of full_datapath_step (bpf_lxc.c:745 ipv6_policy):
+    prefilter drop, conntrack, ipcache identity, policy verdict for
+    CT_NEW flows, CT create gated on the verdict.  (v6 service LB —
+    the reference's lb6 — is not yet wired; daddr passes through.)
+
+    Returns (verdict [B], event [B], identity [B], ct', counters').
+    """
+    from ..ops.lpm_ops import lpm6_lookup
+    from .conntrack import CT_NEW, CTBatch, ct_step
+    from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_PREFILTER,
+                         TRACE_TO_LXC, TRACE_TO_PROXY)
+    from .verdict import VERDICT_DROP, VERDICT_DROP_FRAG, verdict_step
+
+    b = pkt.sport.shape[0]
+
+    # 1. Prefilter (bpf_xdp.c check_v6 analog).
+    if tables.pf6.kb.shape[0] > 0:
+        pf_hit, _ = lpm6_lookup(tables.pf6.masks, tables.pf6.k0,
+                                tables.pf6.k1, tables.pf6.k2,
+                                tables.pf6.k3, tables.pf6.kb,
+                                tables.pf6.value, tables.pf6.plens,
+                                pkt.saddr, pf6_probe)
+    else:
+        pf_hit = jnp.zeros(b, bool)
+
+    # 2. Conntrack on folded addresses (separate v6 table).
+    ctb = CTBatch(saddr=fold6(pkt.saddr), daddr=fold6(pkt.daddr),
+                  sport=pkt.sport, dport=pkt.dport, proto=pkt.proto,
+                  direction=pkt.direction, tcp_flags=pkt.tcp_flags,
+                  related=jnp.zeros_like(pkt.proto))
+
+    # 3. ipcache6: identity of the peer (src on ingress, dst on egress).
+    peer = jnp.where((pkt.direction == 0)[:, None], pkt.saddr, pkt.daddr)
+    if tables.ipcache6.kb.shape[0] > 0:
+        found, ident = lpm6_lookup(
+            tables.ipcache6.masks, tables.ipcache6.k0,
+            tables.ipcache6.k1, tables.ipcache6.k2, tables.ipcache6.k3,
+            tables.ipcache6.kb, tables.ipcache6.value,
+            tables.ipcache6.plens, peer, lpm6_probe)
+    else:
+        found = jnp.zeros(b, bool)
+        ident = jnp.zeros(b, jnp.int32)
+    identity = jnp.where(found, ident, jnp.int32(WORLD_IDENTITY))
+    if pkt.from_overlay is not None:
+        decap = (pkt.from_overlay != 0) & (pkt.direction == 0)
+        identity = jnp.where(decap, pkt.tunnel_id, identity)
+
+    # 4. Policy verdict on the shared (family-agnostic) tables.
+    vb = PacketBatch(endpoint=pkt.endpoint, identity=identity,
+                     dport=pkt.dport, proto=pkt.proto,
+                     direction=pkt.direction, length=pkt.length,
+                     is_fragment=pkt.is_fragment)
+    pol_verdict, counters = verdict_step(tables.key_id, tables.key_meta,
+                                         tables.value, counters, vb,
+                                         policy_probe)
+
+    # 5. CT step, creation gated on the verdict.
+    create_ok = (pol_verdict >= 0) & ~pf_hit
+    proxy_in = jnp.maximum(pol_verdict, 0)
+    ct_verdict, _ct_rev_nat, ct_proxy, ct = ct_step(
+        ct, ctb, now, create_ok, update_mask=~pf_hit,
+        rev_nat_in=jnp.zeros_like(pol_verdict), proxy_port_in=proxy_in,
+        slots=ct_slots, max_probe=ct_probe)
+
+    established = ct_verdict != CT_NEW
+    verdict = jnp.where(
+        pf_hit, jnp.int32(VERDICT_DROP),
+        jnp.where(established, ct_proxy, pol_verdict))
+    event = jnp.where(
+        pf_hit, jnp.int32(DROP_PREFILTER),
+        jnp.where(verdict == VERDICT_DROP_FRAG,
+                  jnp.int32(DROP_FRAG_NOSUPPORT),
+                  jnp.where(verdict < 0, jnp.int32(DROP_POLICY),
+                            jnp.where(verdict > 0,
+                                      jnp.int32(TRACE_TO_PROXY),
+                                      jnp.int32(TRACE_TO_LXC)))))
+    return verdict, event, identity, ct, counters

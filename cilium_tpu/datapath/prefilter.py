@@ -22,8 +22,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..compiler.lpm import CompiledLPM, compile_lpm
-from ..ops.lpm_ops import lpm_lookup
+from ..compiler.lpm import (CompiledLPM, CompiledLPM6, compile_lpm,
+                            compile_lpm6)
+from ..ops.lpm_ops import lpm6_lookup, lpm_lookup
 
 
 class PrefilterType(IntEnum):
@@ -31,13 +32,17 @@ class PrefilterType(IntEnum):
 
     PREFIX_DYN_V4 = 0
     PREFIX_FIX_V4 = 1
-    # v6 variants reserved; the LPM word layout for v6 lands with the
-    # ipcache v6 support.
+    PREFIX_DYN_V6 = 2
+    PREFIX_FIX_V6 = 3
+
+
+_V4_TYPES = (PrefilterType.PREFIX_DYN_V4, PrefilterType.PREFIX_FIX_V4)
+_V6_TYPES = (PrefilterType.PREFIX_DYN_V6, PrefilterType.PREFIX_FIX_V6)
 
 
 class PreFilter:
-    """Manager of deny-CIDR sets compiled to a device LPM
-    (prefilter.go:125 Insert / Delete / Dump)."""
+    """Manager of deny-CIDR sets compiled to device LPMs, both address
+    families (prefilter.go:30-44 four maps, :125 Insert/Delete/Dump)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -45,29 +50,42 @@ class PreFilter:
             t: set() for t in PrefilterType}
         self.revision = 1
         self._compiled: Optional[CompiledLPM] = None
+        self._compiled6: Optional[CompiledLPM6] = None
         self._fn = None
+        self._fn6 = None
+
+    @staticmethod
+    def _family_type(net, which: PrefilterType) -> PrefilterType:
+        """Route a CIDR to the map of its family, keeping the
+        dyn/fixed distinction of the requested type."""
+        dyn = which in (PrefilterType.PREFIX_DYN_V4,
+                        PrefilterType.PREFIX_DYN_V6)
+        if net.version == 4:
+            return PrefilterType.PREFIX_DYN_V4 if dyn \
+                else PrefilterType.PREFIX_FIX_V4
+        return PrefilterType.PREFIX_DYN_V6 if dyn \
+            else PrefilterType.PREFIX_FIX_V6
 
     def insert(self, cidrs: List[str],
                which: PrefilterType = PrefilterType.PREFIX_DYN_V4) -> None:
         with self._lock:
             for c in cidrs:
                 net = ipaddress.ip_network(c, strict=False)
-                if net.version != 4:
-                    raise ValueError("prefilter v6 not yet supported")
-                self._cidrs[which].add(str(net))
+                self._cidrs[self._family_type(net, which)].add(str(net))
             self.revision += 1
             self._recompile()
 
     def delete(self, cidrs: List[str],
                which: PrefilterType = PrefilterType.PREFIX_DYN_V4) -> None:
         with self._lock:
-            for c in cidrs:
-                net = str(ipaddress.ip_network(c, strict=False))
-                if net not in self._cidrs[which]:
+            nets = [ipaddress.ip_network(c, strict=False) for c in cidrs]
+            for net in nets:
+                t = self._family_type(net, which)
+                if str(net) not in self._cidrs[t]:
                     raise KeyError(f"CIDR {net} not in prefilter")
-            for c in cidrs:
-                self._cidrs[which].discard(
-                    str(ipaddress.ip_network(c, strict=False)))
+            for net in nets:
+                self._cidrs[self._family_type(net, which)].discard(
+                    str(net))
             self.revision += 1
             self._recompile()
 
@@ -79,20 +97,42 @@ class PreFilter:
             return out, self.revision
 
     def _recompile(self):
-        all_cidrs = {}
-        for s in self._cidrs.values():
+        v4, v6 = {}, {}
+        for t, s in self._cidrs.items():
+            dst = v4 if t in _V4_TYPES else v6
             for c in s:
-                all_cidrs[c] = 1  # payload unused; presence == deny
-        self._compiled = compile_lpm(all_cidrs)
-        self._fn = jax.jit(functools.partial(
-            lpm_lookup, max_probe=self._compiled.max_probe))
+                dst[c] = 1  # payload unused; presence == deny
+        # only recompile (and re-jit, discarding the trace cache) the
+        # family whose CIDR set actually changed
+        if v4 != getattr(self, "_last_v4", None):
+            self._last_v4 = v4
+            self._compiled = compile_lpm(v4)
+            self._fn = jax.jit(functools.partial(
+                lpm_lookup, max_probe=self._compiled.max_probe))
+        if v6 != getattr(self, "_last_v6", None):
+            self._last_v6 = v6
+            self._compiled6 = compile_lpm6(v6)
+            self._fn6 = jax.jit(functools.partial(
+                lpm6_lookup, max_probe=self._compiled6.max_probe))
 
     def drop_mask(self, src_addrs: jnp.ndarray) -> jnp.ndarray:
-        """[B] bool — True where the source address is denylisted."""
+        """[B] bool — True where the v4 source address is denylisted."""
         if self._compiled is None or self._compiled.entry_count() == 0:
             return jnp.zeros(src_addrs.shape[0], bool)
         c = self._compiled
         found, _ = self._fn(jnp.asarray(c.masks), jnp.asarray(c.key_a),
                             jnp.asarray(c.key_b), jnp.asarray(c.value),
                             jnp.asarray(c.prefix_lens), src_addrs)
+        return found
+
+    def drop_mask6(self, src_addrs: jnp.ndarray) -> jnp.ndarray:
+        """[B] bool for [B, 4] v6 source address words."""
+        if self._compiled6 is None or self._compiled6.entry_count() == 0:
+            return jnp.zeros(src_addrs.shape[0], bool)
+        c = self._compiled6
+        found, _ = self._fn6(jnp.asarray(c.masks), jnp.asarray(c.k0),
+                             jnp.asarray(c.k1), jnp.asarray(c.k2),
+                             jnp.asarray(c.k3), jnp.asarray(c.kb),
+                             jnp.asarray(c.value),
+                             jnp.asarray(c.prefix_lens), src_addrs)
         return found

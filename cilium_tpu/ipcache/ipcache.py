@@ -172,12 +172,23 @@ class IPCache:
             return sorted(self._by_prefix.values(),
                           key=lambda p: p.prefix)
 
-    def to_lpm_prefixes(self) -> Dict[str, int]:
-        """{prefix: identity} for compiler.lpm.compile_lpm — the bridge
-        into the datapath ipcache LPM tensor."""
+    def to_lpm_prefixes(self, family: int = 4) -> Dict[str, int]:
+        """{prefix: identity} for compiler.lpm.compile_lpm /
+        compile_lpm6 — the bridge into the datapath ipcache LPM
+        tensors, one per address family."""
+        return self.to_lpm_prefix_families()[0 if family == 4 else 1]
+
+    def to_lpm_prefix_families(self
+                               ) -> Tuple[Dict[str, int], Dict[str, int]]:
+        """One pass over the cache: ({v4 prefix: id}, {v6 prefix: id}).
+        Family is decided by the prefix string (normalized at upsert),
+        so no CIDR parsing here."""
         with self._lock:
-            return {p.prefix: p.identity
-                    for p in self._by_prefix.values()}
+            v4: Dict[str, int] = {}
+            v6: Dict[str, int] = {}
+            for p in self._by_prefix.values():
+                (v6 if ":" in p.prefix else v4)[p.prefix] = p.identity
+            return v4, v6
 
     def __len__(self):
         with self._lock:

@@ -73,3 +73,61 @@ def lpm_lookup(masks: jnp.ndarray, key_a: jnp.ndarray, key_b: jnp.ndarray,
     any_hit = jnp.any(hit_per_len, axis=1)
     val = jnp.sum(jnp.where(first_mask, val_per_len, jnp.int32(0)), axis=1)
     return any_hit, jnp.where(any_hit, val, jnp.int32(LPM_MISS))
+
+
+def _hash6_jnp(w0, w1, w2, w3, occ):
+    """Device twin of compiler.lpm._hash6 — keep in lockstep."""
+    return hash_mix_jnp(hash_mix_jnp(w0, w1),
+                        hash_mix_jnp(w2 ^ occ, w3))
+
+
+def lpm6_lookup(masks: jnp.ndarray, k0: jnp.ndarray, k1: jnp.ndarray,
+                k2: jnp.ndarray, k3: jnp.ndarray, kb: jnp.ndarray,
+                value: jnp.ndarray, prefix_lens: jnp.ndarray,
+                addrs: jnp.ndarray, max_probe: int
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """IPv6 LPM over stacked per-length tables (full 128-bit compare).
+
+    masks: [P, 4]; k0..k3/kb/value: [P, S]; prefix_lens: [P]
+    (descending); addrs: [B, 4] int32 big-endian words.
+    Returns (found [B] bool, value [B] int32 — LPM_MISS on miss).
+    """
+    p, slots = kb.shape
+    b = addrs.shape[0]
+    if p == 0:
+        return jnp.zeros(b, bool), jnp.full(b, LPM_MISS, jnp.int32)
+    mask_slots = jnp.int32(slots - 1)
+
+    # [B, P] masked words
+    a = addrs.astype(jnp.int32)
+    m = masks.astype(jnp.int32)
+    w = [a[:, None, i] & m[None, :, i] for i in range(4)]
+    occ = ((prefix_lens.astype(jnp.int32) << 1) | 1)[None, :]      # [1, P]
+    occ = jnp.broadcast_to(occ, w[0].shape)
+
+    h = _hash6_jnp(w[0], w[1], w[2], w[3], occ)
+    base = h & mask_slots                                          # [B, P]
+    probes = (base[:, :, None] +
+              jnp.arange(max_probe, dtype=jnp.int32)[None, None, :]) \
+        & mask_slots
+    row_off = (jnp.arange(p, dtype=jnp.int32) * jnp.int32(slots))[None, :, None]
+    idx2 = (row_off + probes).reshape(b, p * max_probe)
+
+    def gather(t):
+        return t.reshape(-1)[idx2].reshape(b, p, max_probe)
+
+    hit = (gather(k0) == w[0][:, :, None]) & \
+        (gather(k1) == w[1][:, :, None]) & \
+        (gather(k2) == w[2][:, :, None]) & \
+        (gather(k3) == w[3][:, :, None])
+    got_b = gather(kb)
+    got_v = gather(value)
+    hit = hit & (got_b == occ[:, :, None]) & (got_b != 0)
+
+    hit_per_len = jnp.any(hit, axis=2)
+    val_per_len = jnp.sum(jnp.where(hit, got_v, jnp.int32(0)), axis=2)
+    first_mask = hit_per_len & (jnp.cumsum(hit_per_len.astype(jnp.int32),
+                                           axis=1) == 1)
+    any_hit = jnp.any(hit_per_len, axis=1)
+    val = jnp.sum(jnp.where(first_mask, val_per_len, jnp.int32(0)), axis=1)
+    return any_hit, jnp.where(any_hit, val, jnp.int32(LPM_MISS))
