@@ -35,11 +35,10 @@ def _bench(step, iters, warmup=1):
     return total, float(np.percentile(np.array(lat), 99) * 1e6)
 
 
-def _emit(metric, value, unit, target, extra):
-    print(json.dumps({
-        "metric": metric, "value": round(value),
-        "unit": unit, "vs_baseline": round(value / target, 3),
-        "extra": extra}))
+def _result(metric, value, unit, target, extra):
+    return {"metric": metric, "value": round(value),
+            "unit": unit, "vs_baseline": round(value / target, 3),
+            "extra": extra}
 
 
 def bench_identity_l4(on_accel: bool):
@@ -92,7 +91,7 @@ def bench_identity_l4(on_accel: bool):
 
     iters = 20 if on_accel else 5
     total, p99 = _bench(step, iters)
-    _emit("policy_verdicts_per_sec_identity_l4",
+    return _result("policy_verdicts_per_sec_identity_l4",
           iters * batch / total, "verdicts/s", 10_000_000.0,
           {"endpoints": n_endpoints, "rules_per_endpoint": rules_per_ep,
            "entries": tables.entry_count(), "batch": batch,
@@ -130,7 +129,7 @@ def bench_http_regex(on_accel: bool):
 
     iters = 10 if on_accel else 3
     total, p99 = _bench(step, iters)
-    _emit("http_requests_checked_per_sec", iters * batch / total,
+    return _result("http_requests_checked_per_sec", iters * batch / total,
           "requests/s", 1_000_000.0,
           {"rules": len(rules), "batch": batch,
            "p99_batch_latency_us": round(p99, 1)})
@@ -157,7 +156,7 @@ def bench_kafka_acl(on_accel: bool):
 
     iters = 10 if on_accel else 3
     total, p99 = _bench(step, iters)
-    _emit("kafka_requests_checked_per_sec", iters * batch / total,
+    return _result("kafka_requests_checked_per_sec", iters * batch / total,
           "requests/s", 1_000_000.0,
           {"rules": len(rules), "batch": batch,
            "p99_batch_latency_us": round(p99, 1)})
@@ -180,7 +179,7 @@ def bench_fqdn(on_accel: bool):
 
     iters = 10 if on_accel else 3
     total, p99 = _bench(step, iters)
-    _emit("fqdn_names_checked_per_sec", iters * batch / total,
+    return _result("fqdn_names_checked_per_sec", iters * batch / total,
           "names/s", 1_000_000.0,
           {"selectors": len(sels), "batch": batch,
            "p99_batch_latency_us": round(p99, 1)})
@@ -199,7 +198,7 @@ def run_suite():
     _backend, on_accel = apply_env_platform()
     wanted = sys.argv[1:] or list(CONFIGS)
     for name in wanted:
-        CONFIGS[name](on_accel)
+        print(json.dumps(CONFIGS[name](on_accel)))
 
 
 def main():
