@@ -1,0 +1,115 @@
+"""Proxy process supervision (pkg/envoy/envoy.go:145).
+
+The reference starts Envoy as a child process and restarts it when it
+dies, in a monitor goroutine with backoff.  ProxySupervisor does the
+same for the out-of-process socket proxy (l7/proxy_child.py): spawn,
+wait, restart with exponential backoff; a restarted child re-subscribes
+over the xDS wire and re-applies the current policy version, so the
+plane self-heals after a crash or kill -9.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+
+class ProxySupervisor:
+    """Spawn + monitor + restart one proxy child process."""
+
+    def __init__(self, xds_port: int, backoff_base: float = 0.2,
+                 backoff_max: float = 5.0,
+                 env: Optional[dict] = None):
+        self.xds_port = xds_port
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.env = env
+        self._proc: Optional[subprocess.Popen] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.restarts = 0
+        self._monitor: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> "ProxySupervisor":
+        self._spawn()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="proxy-supervisor")
+        self._monitor.start()
+        return self
+
+    def _spawn(self) -> None:
+        env = dict(os.environ if self.env is None else self.env)
+        # the proxy child never needs the accelerator; FORCE cpu (the
+        # ambient image env pins the axon TPU plugin, and a child that
+        # inherits it stalls dialing the relay on first regex compile)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.l7.proxy_child",
+             str(self.xds_port)],
+            stdout=subprocess.PIPE, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+        # block until the child says it subscribed (envoy.go waits for
+        # the admin socket the same way)
+        line = proc.stdout.readline()
+        if not line.startswith("READY"):
+            raise RuntimeError(f"proxy child failed to start: {line!r}")
+        with self._lock:
+            self._proc = proc
+
+    def _monitor_loop(self) -> None:
+        backoff = self.backoff_base
+        while not self._stop.is_set():
+            with self._lock:
+                proc = self._proc
+            if proc is None:
+                return
+            rc = proc.wait()
+            if self._stop.is_set():
+                return
+            # child died (crash / kill -9): restart with backoff
+            time.sleep(backoff)
+            backoff = min(backoff * 2, self.backoff_max)
+            if self._stop.is_set():
+                return  # shutdown raced the backoff sleep: no respawn
+            try:
+                self._spawn()
+                self.restarts += 1
+                backoff = self.backoff_base
+            except (RuntimeError, OSError):
+                continue  # retry after a longer backoff
+            if self._stop.is_set():
+                # shutdown landed between its proc-kill and our spawn:
+                # don't leave an orphan child running forever
+                self.shutdown()
+                return
+
+    # ------------------------------------------------------------- status
+
+    @property
+    def pid(self) -> Optional[int]:
+        with self._lock:
+            return self._proc.pid if self._proc else None
+
+    def alive(self) -> bool:
+        with self._lock:
+            return self._proc is not None and self._proc.poll() is None
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            proc = self._proc
+            self._proc = None
+        if proc is not None:
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except OSError:
+                pass

@@ -151,7 +151,10 @@ class ProxyManager:
                 self._redirects[rid] = redir
             redir.parser_type = flt.l7_parser
             redir.l7_filter = flt
-            return redir
+        cb = getattr(self, "on_change", None)
+        if cb is not None:
+            cb()
+        return redir
 
     def remove_redirect(self, rid: str) -> bool:
         with self._lock:
@@ -164,6 +167,9 @@ class ProxyManager:
                 self.dataplane.stop_listener(rid)
             except Exception:  # noqa: BLE001
                 pass
+        cb = getattr(self, "on_change", None)
+        if cb is not None:
+            cb()
         return True
 
     # -- socket data plane ---------------------------------------------------
