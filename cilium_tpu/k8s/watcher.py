@@ -1,22 +1,31 @@
 """k8s event watcher driving the daemon.
 
 Reference: daemon/k8s_watcher.go — informers for CNPs, k8s
-NetworkPolicies, Services, Endpoints, Pods and Namespaces feed the
-policy repository and the service/endpoint state. Here the watcher is a
-sink for an event stream (dicts shaped like k8s watch events); any
-source — a test, a file replay, or a real apiserver client — pushes
-into it.
+NetworkPolicies, Services, Endpoints, Pods, Nodes, Namespaces and
+Ingresses feed the policy repository, the service/endpoint state, the
+ipcache, and node tunneling; the agent reports per-node CNP status
+back (k8s_watcher.go:1748 cnpNodeStatusController).  Here the watcher
+is a sink for an event stream (dicts shaped like k8s watch events);
+any source — a test, a file replay, or a real apiserver client —
+pushes into it.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
+from ..identity import RESERVED_UNMANAGED
 from ..labels import LabelArray, Label, SOURCE_K8S
+from ..node import Node, NodeAddress
 from .policy import (POLICY_LABEL_NAME, POLICY_LABEL_NAMESPACE,
                      parse_cnp, parse_network_policy)
 from .translate import endpoints_to_ips, translate_to_services
+
+# namespace meta labels carried onto pods in that namespace
+# (reference: ciliumio.PodNamespaceMetaLabels prefix)
+NS_META_PREFIX = "io.cilium.k8s.namespace.labels"
 
 
 def _policy_key_labels(name: str, namespace: str) -> LabelArray:
@@ -29,29 +38,83 @@ def _policy_key_labels(name: str, namespace: str) -> LabelArray:
 class K8sWatcher:
     """Apply k8s object events to a Daemon."""
 
-    def __init__(self, daemon):
+    def __init__(self, daemon, ingress_host_ip: str = "192.168.254.1"):
         self.daemon = daemon
         self._lock = threading.Lock()
         # (namespace, service) -> backend ips, for ToServices
         self._endpoints: Dict[tuple, List[str]] = {}
+        # (namespace, service) -> {"headless": bool, "ports": [...]}
+        self._services: Dict[tuple, Dict] = {}
+        # (namespace, cnp name) -> {node: status dict} — the per-node
+        # CNP status the reference writes back to the apiserver
+        # (k8s_watcher.go:1834 updateCNPNodeStatus)
+        self.cnp_status: Dict[tuple, Dict[str, Dict]] = {}
+        # namespace -> its labels (for pod namespace meta labels)
+        self._ns_labels: Dict[str, Dict[str, str]] = {}
+        # the address ingress frontends resolve to on this node
+        # (reference: option.Config.HostV4Addr)
+        self.ingress_host_ip = ingress_host_ip
+        # (namespace, ingress name) -> (service name, servicePort)
+        self._ingresses: Dict[tuple, tuple] = {}
+        # (namespace, ingress name) -> last programmed frontend port
+        self._ingress_ports: Dict[tuple, int] = {}
+        # (namespace, pod name) -> last known podIP (for IP-change
+        # cleanup on modified events)
+        self._pod_ips: Dict[tuple, str] = {}
         self.events_processed = 0
+        self.events_by_kind: Dict[str, int] = {}
 
     # ------------------------------------------------------------ policy
 
     def on_cnp(self, action: str, obj: Dict) -> None:
         """action: added | modified | deleted
-        (k8s_watcher.go addCiliumNetworkPolicyV2 et al.)."""
+        (k8s_watcher.go addCiliumNetworkPolicyV2 et al.).  Records the
+        per-node enforcement status the reference writes back into the
+        CNP's Status.Nodes map (cnpNodeStatusController): ok/enforcing
+        with the realized revision on success, the import error
+        otherwise."""
         meta = obj.get("metadata") or {}
         name = meta.get("name", "")
         namespace = meta.get("namespace", "default")
+        skey = (namespace, name)
         key = _policy_key_labels(name, namespace)
+        node = self.daemon.node_name
         if action in ("added", "modified"):
-            rules = parse_cnp(obj)
-            self._retranslate(rules)
-            self.daemon.policy_add(rules, replace=True)
+            try:
+                rules = parse_cnp(obj)
+                self._retranslate(rules)
+                rev = self.daemon.policy_add(rules, replace=True)
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                self.cnp_status.setdefault(skey, {})[node] = {
+                    "ok": False, "enforcing": False, "error": repr(e),
+                    "lastUpdated": time.time()}
+                self._count("cnp")
+                return
+            # enforcing = every endpoint realized the revision; the
+            # reference waits via a controller — do the same async so
+            # slow builds don't block the event stream
+            self.cnp_status.setdefault(skey, {})[node] = {
+                "ok": True, "enforcing": False, "revision": rev,
+                "lastUpdated": time.time()}
+
+            def _wait_enforced():
+                if self.daemon.wait_for_policy_revision(rev, timeout=30):
+                    st = self.cnp_status.get(skey, {}).get(node)
+                    if st is not None and st.get("revision") == rev:
+                        st["enforcing"] = True
+                        st["lastUpdated"] = time.time()
+
+            threading.Thread(target=_wait_enforced, daemon=True,
+                             name=f"cnp-status-{name}").start()
         elif action == "deleted":
             self.daemon.policy_delete(key)
-        self._count()
+            self.cnp_status.pop(skey, None)
+        self._count("cnp")
+
+    def get_cnp_status(self, namespace: str, name: str
+                       ) -> Dict[str, Dict]:
+        """The CNP's per-node status map (Status.Nodes analog)."""
+        return dict(self.cnp_status.get((namespace, name), {}))
 
     def on_network_policy(self, action: str, obj: Dict) -> None:
         meta = obj.get("metadata") or {}
@@ -62,7 +125,7 @@ class K8sWatcher:
             self.daemon.policy_add(rules, replace=True)
         elif action == "deleted":
             self.daemon.policy_delete(key)
-        self._count()
+        self._count("network-policy")
 
     # --------------------------------------------------------- services
 
@@ -72,13 +135,25 @@ class K8sWatcher:
         meta = obj.get("metadata") or {}
         spec = obj.get("spec") or {}
         vip = spec.get("clusterIP")
-        if not vip or vip == "None":
-            return
         key = (meta.get("namespace", "default"), meta.get("name", ""))
+        if not vip or vip == "None":
+            # headless service: tracked (its Endpoints still drive
+            # ToServices translation) but never programmed into the LB
+            # (k8s_watcher.go:801-805, :957)
+            if action == "deleted":
+                self._services.pop(key, None)
+            else:
+                self._services[key] = {"headless": True,
+                                       "ports": spec.get("ports") or []}
+            self._count("service")
+            return
         if action == "deleted":
+            self._services.pop(key, None)
             for p in spec.get("ports") or []:
                 self.daemon.service_delete(vip, int(p.get("port", 0)))
         else:
+            self._services[key] = {"headless": False, "vip": vip,
+                                   "ports": spec.get("ports") or []}
             backends = self._endpoints.get(key, [])
             for p in spec.get("ports") or []:
                 port = int(p.get("port", 0))
@@ -91,7 +166,9 @@ class K8sWatcher:
                     target = port
                 self.daemon.service_upsert(
                     vip, port, [(ip, target) for ip in backends])
-        self._count()
+        # the service spec (e.g. targetPort) feeds ingress frontends
+        self._resync_ingresses_for(key[0], key[1])
+        self._count("service")
 
     def on_endpoints(self, action: str, obj: Dict) -> None:
         """Endpoints drive both LB backends and ToServices translation
@@ -124,7 +201,195 @@ class K8sWatcher:
             # entries before the regenerated policy can match them
             self.daemon.resync_rule_prefixes(rules)
             self.daemon.trigger_policy_updates("k8s-endpoints")
-        self._count()
+        self._resync_ingresses_for(key[0], key[1])
+        self._count("endpoints")
+
+    # ------------------------------------------------------------- pods
+
+    def on_pod(self, action: str, obj: Dict) -> None:
+        """Pods feed the ipcache (podIP -> unmanaged identity until the
+        allocator decides — k8s_watcher.go:1964 updatePodHostIP) and
+        pod label changes re-resolve the endpoint's identity
+        (:2041 updateK8sPodV1)."""
+        meta = obj.get("metadata") or {}
+        status = obj.get("status") or {}
+        spec = obj.get("spec") or {}
+        namespace = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        pkey = (namespace, name)
+        pod_ip = status.get("podIP", "")
+        host_ip = status.get("hostIP", "")
+        if action == "deleted":
+            known = self._pod_ips.pop(pkey, "") or pod_ip
+            if known:
+                self.daemon.ipcache.delete(known, "k8s")
+            self._count("pod")
+            return
+        # ipcache mapping — skipped for host-networking pods or before
+        # an IP is assigned, exactly like updatePodHostIP.  A changed
+        # podIP (sandbox restart) drops the stale entry first, or IPAM
+        # reuse would leave a shadowing unmanaged mapping behind.
+        old_ip = self._pod_ips.get(pkey, "")
+        if not spec.get("hostNetwork") and pod_ip and host_ip:
+            if old_ip and old_ip != pod_ip:
+                self.daemon.ipcache.delete(old_ip, "k8s")
+            self.daemon.ipcache.upsert(pod_ip, RESERVED_UNMANAGED,
+                                       "k8s", host_ip=host_ip,
+                                       metadata=f"pod:{namespace}/{name}")
+            self._pod_ips[pkey] = pod_ip
+        if action == "modified":
+            # label updates re-resolve the pod's endpoint identity;
+            # namespace meta labels ride along (reference both paths)
+            ep = self.daemon.endpoints.lookup_container(
+                f"{namespace}/{name}")
+            if ep is not None:
+                self.daemon.endpoint_update_labels(
+                    ep.id, self._merged_labels(
+                        ep, namespace, meta.get("labels") or {}))
+        self._count("pod")
+
+    def _pod_identity_labels(self, namespace: str,
+                             pod_labels: Dict[str, str]) -> List[str]:
+        out = [f"k8s:{k}={v}" for k, v in sorted(pod_labels.items())]
+        for k, v in sorted(self._ns_labels.get(namespace, {}).items()):
+            out.append(f"k8s:{NS_META_PREFIX}.{k}={v}")
+        return out
+
+    def _merged_labels(self, ep, namespace: str,
+                       pod_labels: Dict[str, str]) -> List[str]:
+        """New full label set for the endpoint: its NON-k8s labels are
+        preserved (update_labels replaces the whole set — dropping a
+        container:/custom label would flip the identity wrongly), k8s
+        pod labels + namespace meta labels are rebuilt."""
+        keep = [str(lb) for lb in ep.labels.values()
+                if lb.source != SOURCE_K8S]
+        return keep + self._pod_identity_labels(namespace, pod_labels)
+
+    # ------------------------------------------------------------ nodes
+
+    def on_node(self, action: str, obj: Dict) -> None:
+        """Node events program per-node tunneling + ipcache
+        (k8s_watcher.go:2303 addK8sNodeV1 -> updateK8sNodeTunneling)."""
+        meta = obj.get("metadata") or {}
+        spec = obj.get("spec") or {}
+        status = obj.get("status") or {}
+        name = meta.get("name", "")
+        if action == "deleted":
+            self.daemon.node_manager.node_deleted(
+                f"{self.daemon.config.cluster_name}/{name}")
+            self._count("node")
+            return
+        addresses = [NodeAddress(a.get("type", ""), a.get("address", ""))
+                     for a in status.get("addresses") or []]
+        node = Node(name=name,
+                    cluster=self.daemon.config.cluster_name,
+                    addresses=addresses,
+                    ipv4_alloc_cidr=spec.get("podCIDR") or None)
+        self.daemon.node_manager.node_updated(node)
+        self._count("node")
+
+    # ------------------------------------------------------- namespaces
+
+    def on_namespace(self, action: str, obj: Dict) -> None:
+        """Namespace label changes re-resolve identities of every
+        endpoint in the namespace (k8s_watcher.go:2145
+        updateK8sV1Namespace — labels carried under the namespace meta
+        prefix)."""
+        meta = obj.get("metadata") or {}
+        name = meta.get("name", "")
+        new_labels = dict(meta.get("labels") or {})
+        old_labels = self._ns_labels.get(name, {})
+        if action == "deleted":
+            self._ns_labels.pop(name, None)
+            self._count("namespace")
+            return
+        self._ns_labels[name] = new_labels
+        if new_labels == old_labels:
+            self._count("namespace")
+            return
+        prefix = f"{name}/"
+        for ep in self.daemon.endpoints.endpoints():
+            cn = ep.container_name or ""
+            if not cn.startswith(prefix):
+                continue
+            pod_labels = {
+                lb.key: lb.value for lb in ep.labels.values()
+                if lb.source == SOURCE_K8S and
+                not lb.key.startswith(NS_META_PREFIX)}
+            self.daemon.endpoint_update_labels(
+                ep.id, self._merged_labels(ep, name, pod_labels))
+        self._count("namespace")
+
+    # ---------------------------------------------------------- ingress
+
+    def on_ingress(self, action: str, obj: Dict) -> None:
+        """Single-service ingress -> an external frontend on the host
+        address forwarding to the backing service's backends
+        (k8s_watcher.go:1376 addIngressV1beta1 + syncExternalLB)."""
+        meta = obj.get("metadata") or {}
+        spec = obj.get("spec") or {}
+        backend = spec.get("backend") or {}
+        svc_name = backend.get("serviceName", "")
+        if not svc_name:
+            self._count("ingress")
+            return  # only single-service ingress is supported
+        namespace = meta.get("namespace", "default")
+        key = (namespace, meta.get("name", ""))
+        try:
+            port = int(backend.get("servicePort") or 0)
+        except (TypeError, ValueError):
+            self._count("ingress")
+            return
+        if action == "deleted":
+            self._ingresses.pop(key, None)
+            old_port = self._ingress_ports.pop(key, None)
+            if old_port:
+                self.daemon.service_delete(self.ingress_host_ip,
+                                           old_port)
+            self._count("ingress")
+            return
+        # a changed servicePort must drop the old frontend, or traffic
+        # to the stale host port keeps forwarding forever
+        old_port = self._ingress_ports.get(key)
+        if old_port and old_port != port:
+            self.daemon.service_delete(self.ingress_host_ip, old_port)
+        self._ingresses[key] = (svc_name, port)
+        self._program_ingress(key)
+        self._count("ingress")
+
+    def _ingress_target_port(self, namespace: str, svc_name: str,
+                             service_port: int) -> int:
+        """Resolve the backing service's targetPort for the ingress
+        servicePort (reference resolves through the service spec)."""
+        svc = self._services.get((namespace, svc_name))
+        if svc:
+            for p in svc.get("ports") or []:
+                if int(p.get("port", 0)) == service_port:
+                    try:
+                        return int(p.get("targetPort") or service_port)
+                    except (TypeError, ValueError):
+                        return service_port  # named port fallback
+        return service_port
+
+    def _program_ingress(self, key: tuple) -> None:
+        svc_name, port = self._ingresses[key]
+        namespace = key[0]
+        backends = self._endpoints.get((namespace, svc_name), [])
+        target = self._ingress_target_port(namespace, svc_name, port)
+        self.daemon.service_upsert(
+            self.ingress_host_ip, port,
+            [(ip, target) for ip in backends])
+        self._ingress_ports[key] = port
+
+    def _resync_ingresses_for(self, namespace: str,
+                              svc_name: str) -> None:
+        """Endpoints/service churn re-programs dependent ingress
+        frontends (syncExternalLB on endpoint events)."""
+        for key, (svc, _port) in list(self._ingresses.items()):
+            if key[0] == namespace and svc == svc_name:
+                self._program_ingress(key)
+
+    # ---------------------------------------------------------- plumbing
 
     def _retranslate(self, rules) -> None:
         with self._lock:
@@ -132,6 +397,8 @@ class K8sWatcher:
         for (ns, svc), ips in snapshot.items():
             translate_to_services(rules, svc, ns, ips)
 
-    def _count(self) -> None:
+    def _count(self, kind: str = "other") -> None:
         with self._lock:
             self.events_processed += 1
+            self.events_by_kind[kind] = \
+                self.events_by_kind.get(kind, 0) + 1

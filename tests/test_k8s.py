@@ -192,3 +192,255 @@ def test_watcher_toservices_retranslation():
             ["10.0.0.9/32"]
     finally:
         d.shutdown()
+
+
+# ------------------------------------------- widened watcher coverage
+# (k8s_watcher.go:70-78,549-560: Pods, Nodes, Namespaces, Ingress
+#  informers + per-node CNP status updates)
+
+POD = {
+    "metadata": {"name": "web-1", "namespace": "prod",
+                 "labels": {"app": "web"}},
+    "spec": {},
+    "status": {"podIP": "10.30.1.5", "hostIP": "192.168.3.1"},
+}
+
+
+def test_watcher_pod_feeds_ipcache():
+    from cilium_tpu.identity import RESERVED_UNMANAGED
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        w.on_pod("added", POD)
+        assert d.ipcache.lookup_by_ip("10.30.1.5") == RESERVED_UNMANAGED
+        # host-networking pods are skipped (updatePodHostIP)
+        w.on_pod("added", {
+            "metadata": {"name": "hostpod", "namespace": "prod"},
+            "spec": {"hostNetwork": True},
+            "status": {"podIP": "192.168.3.1",
+                       "hostIP": "192.168.3.1"}})
+        assert d.ipcache.lookup_by_ip("192.168.3.1") is None
+        w.on_pod("deleted", POD)
+        assert d.ipcache.lookup_by_ip("10.30.1.5") is None
+        assert w.events_by_kind["pod"] == 3
+    finally:
+        d.shutdown()
+
+
+def test_watcher_pod_label_update_changes_endpoint_identity():
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        ep = d.endpoint_create(1, ipv4="10.30.1.5",
+                               container_name="prod/web-1",
+                               labels=["k8s:app=web"])
+        ident_before = ep.security_identity
+        relabeled = {
+            "metadata": {"name": "web-1", "namespace": "prod",
+                         "labels": {"app": "web", "tier": "gold"}},
+            "spec": {},
+            "status": {"podIP": "10.30.1.5",
+                       "hostIP": "192.168.3.1"}}
+        w.on_pod("modified", relabeled)
+        assert ep.security_identity != ident_before
+        assert any(lb.key == "tier" for lb in ep.labels.values())
+    finally:
+        d.shutdown()
+
+
+def test_watcher_node_programs_tunnel():
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        w.on_node("added", {
+            "metadata": {"name": "worker-2"},
+            "spec": {"podCIDR": "10.31.0.0/24"},
+            "status": {"addresses": [
+                {"type": "InternalIP", "address": "192.168.3.2"}]}})
+        assert "10.31.0.0/24" in d.datapath.tunnel_prefixes
+        assert d.node_manager.tunnel_map["10.31.0.0/24"] == \
+            "192.168.3.2"
+        w.on_node("deleted", {"metadata": {"name": "worker-2"}})
+        assert d.datapath.tunnel_prefixes == {}
+    finally:
+        d.shutdown()
+
+
+def test_watcher_namespace_labels_reresolve_endpoints():
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        ep = d.endpoint_create(1, ipv4="10.30.1.6",
+                               container_name="prod/web-2",
+                               labels=["k8s:app=web"])
+        ident_before = ep.security_identity
+        w.on_namespace("added", {
+            "metadata": {"name": "prod",
+                         "labels": {"env": "production"}}})
+        assert ep.security_identity != ident_before
+        ns_keys = [lb.key for lb in ep.labels.values()]
+        assert any("namespace.labels.env" in k for k in ns_keys)
+        # same labels again: no further identity churn
+        ident_stable = ep.security_identity
+        w.on_namespace("modified", {
+            "metadata": {"name": "prod",
+                         "labels": {"env": "production"}}})
+        assert ep.security_identity == ident_stable
+    finally:
+        d.shutdown()
+
+
+def test_watcher_ingress_programs_external_frontend():
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d, ingress_host_ip="192.0.2.1")
+    try:
+        w.on_endpoints("added", {
+            "metadata": {"name": "web", "namespace": "prod"},
+            "subsets": [{"addresses": [{"ip": "10.30.1.7"}],
+                         "ports": [{"port": 8080}]}]})
+        w.on_ingress("added", {
+            "metadata": {"name": "web-ing", "namespace": "prod"},
+            "spec": {"backend": {"serviceName": "web",
+                                 "servicePort": 8080}}})
+        svcs = d.datapath.lb.services()
+        assert any(s.port == 8080 and len(s.backends) == 1
+                   for s in svcs)
+        w.on_ingress("deleted", {
+            "metadata": {"name": "web-ing", "namespace": "prod"},
+            "spec": {"backend": {"serviceName": "web",
+                                 "servicePort": 8080}}})
+        assert not d.datapath.lb.services()
+    finally:
+        d.shutdown()
+
+
+def test_watcher_headless_service_not_programmed():
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        w.on_service("added", {
+            "metadata": {"name": "hs", "namespace": "prod"},
+            "spec": {"clusterIP": "None", "ports": [{"port": 9042}]}})
+        assert len(d.datapath.lb) == 0  # never programmed into the LB
+        assert w._services[("prod", "hs")]["headless"] is True
+        w.on_service("deleted", {
+            "metadata": {"name": "hs", "namespace": "prod"},
+            "spec": {"clusterIP": "None", "ports": [{"port": 9042}]}})
+        assert ("prod", "hs") not in w._services
+    finally:
+        d.shutdown()
+
+
+def test_watcher_cnp_node_status():
+    import time as _t
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        w.on_cnp("added", CNP)
+        st = w.get_cnp_status("prod", "web-policy")
+        assert d.node_name in st
+        node_st = st[d.node_name]
+        assert node_st["ok"] and "revision" in node_st
+        # enforcement status flips once endpoints realize the revision
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            node_st = w.get_cnp_status("prod",
+                                       "web-policy")[d.node_name]
+            if node_st["enforcing"]:
+                break
+            _t.sleep(0.05)
+        assert node_st["enforcing"]
+        # a broken CNP reports the import error instead
+        w.on_cnp("added", {
+            "metadata": {"name": "bad", "namespace": "prod"},
+            "spec": {"endpointSelector": {"matchLabels": {"a": "b"}},
+                     "ingress": [{"fromCIDR": ["not-a-cidr"]}]}})
+        bad = w.get_cnp_status("prod", "bad")[d.node_name]
+        assert not bad["ok"] and "error" in bad
+        # deletion clears the status
+        w.on_cnp("deleted", CNP)
+        assert w.get_cnp_status("prod", "web-policy") == {}
+    finally:
+        d.shutdown()
+
+
+def test_watcher_ingress_resync_and_target_port():
+    """Review regressions: ingress frontends follow Endpoints churn,
+    use the service's targetPort, and a servicePort change drops the
+    old frontend."""
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d, ingress_host_ip="192.0.2.1")
+    try:
+        # ingress BEFORE endpoints exist: programmed with 0 backends
+        w.on_service("added", {
+            "metadata": {"name": "web", "namespace": "prod"},
+            "spec": {"clusterIP": "10.96.0.20",
+                     "ports": [{"port": 80, "targetPort": 8080}]}})
+        w.on_ingress("added", {
+            "metadata": {"name": "ing", "namespace": "prod"},
+            "spec": {"backend": {"serviceName": "web",
+                                 "servicePort": 80}}})
+        # endpoints arrive later: the frontend is resynced with the
+        # targetPort-resolved backends
+        w.on_endpoints("added", {
+            "metadata": {"name": "web", "namespace": "prod"},
+            "subsets": [{"addresses": [{"ip": "10.30.2.1"}],
+                         "ports": [{"port": 8080}]}]})
+        from cilium_tpu.compiler.lpm import ipv4_to_u32
+        ing = [s for s in d.datapath.lb.services()
+               if s.vip == ipv4_to_u32("192.0.2.1")]
+        assert ing and len(ing[0].backends) == 1
+        assert ing[0].backends[0].port == 8080  # targetPort, not 80
+        # servicePort change: old frontend removed, new programmed
+        w.on_ingress("modified", {
+            "metadata": {"name": "ing", "namespace": "prod"},
+            "spec": {"backend": {"serviceName": "web",
+                                 "servicePort": 81}}})
+        ports = [s.port for s in d.datapath.lb.services()
+                 if s.vip == ipv4_to_u32("192.0.2.1")]
+        assert ports == [81]
+    finally:
+        d.shutdown()
+
+
+def test_watcher_pod_ip_change_cleans_stale_entry():
+    from cilium_tpu.identity import RESERVED_UNMANAGED
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        w.on_pod("added", POD)
+        assert d.ipcache.lookup_by_ip("10.30.1.5") == RESERVED_UNMANAGED
+        moved = {"metadata": {"name": "web-1", "namespace": "prod"},
+                 "spec": {},
+                 "status": {"podIP": "10.30.1.99",
+                            "hostIP": "192.168.3.1"}}
+        w.on_pod("modified", moved)
+        assert d.ipcache.lookup_by_ip("10.30.1.5") is None  # stale gone
+        assert d.ipcache.lookup_by_ip("10.30.1.99") == RESERVED_UNMANAGED
+        w.on_pod("deleted", moved)
+        assert d.ipcache.lookup_by_ip("10.30.1.99") is None
+    finally:
+        d.shutdown()
+
+
+def test_watcher_label_updates_preserve_non_k8s_labels():
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d)
+    try:
+        ep = d.endpoint_create(
+            1, ipv4="10.30.1.8", container_name="prod/web-3",
+            labels=["k8s:app=web", "container:runtime=docker"])
+        w.on_namespace("added", {
+            "metadata": {"name": "prod", "labels": {"env": "prod"}}})
+        srcs = {lb.source for lb in ep.labels.values()}
+        assert "container" in srcs  # non-k8s label survived
+        w.on_pod("modified", {
+            "metadata": {"name": "web-3", "namespace": "prod",
+                         "labels": {"app": "web", "v": "2"}},
+            "spec": {}, "status": {"podIP": "10.30.1.8",
+                                   "hostIP": "192.168.3.1"}})
+        srcs = {lb.source for lb in ep.labels.values()}
+        assert "container" in srcs
+        assert any(lb.key == "v" for lb in ep.labels.values())
+    finally:
+        d.shutdown()
