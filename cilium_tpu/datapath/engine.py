@@ -297,7 +297,8 @@ class Datapath:
 def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
                     direction=None, tcp_flags=None, length=None,
                     is_fragment=None, from_overlay=None,
-                    tunnel_id=None) -> FullPacketBatch:
+                    tunnel_id=None, mark_identity=None
+                    ) -> FullPacketBatch:
     n = len(np.asarray(endpoint))
     arr = lambda x, d: jnp.asarray(np.asarray(
         x if x is not None else np.full(n, d), np.int32))
@@ -317,6 +318,8 @@ def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
     if from_overlay is not None or tunnel_id is not None:
         overlay_fields = dict(from_overlay=arr(from_overlay, 0),
                               tunnel_id=arr(tunnel_id, 0))
+    if mark_identity is not None:
+        overlay_fields["mark_identity"] = arr(mark_identity, 0)
     return FullPacketBatch(
         endpoint=arr(endpoint, 0), saddr=addr(saddr), daddr=addr(daddr),
         sport=arr(sport, 0), dport=arr(dport, 0), proto=arr(proto, 6),
@@ -328,7 +331,8 @@ def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
 def make_full_batch6(endpoint, saddr, daddr, sport, dport, proto=None,
                      direction=None, tcp_flags=None, length=None,
                      is_fragment=None, from_overlay=None,
-                     tunnel_id=None) -> FullPacketBatch6:
+                     tunnel_id=None, mark_identity=None
+                     ) -> FullPacketBatch6:
     """v6 batch builder: saddr/daddr accept v6 strings or [B, 4] int32
     word arrays."""
     n = len(np.asarray(endpoint))
@@ -351,6 +355,8 @@ def make_full_batch6(endpoint, saddr, daddr, sport, dport, proto=None,
     if from_overlay is not None or tunnel_id is not None:
         overlay_fields = dict(from_overlay=arr(from_overlay, 0),
                               tunnel_id=arr(tunnel_id, 0))
+    if mark_identity is not None:
+        overlay_fields["mark_identity"] = arr(mark_identity, 0)
     return FullPacketBatch6(
         endpoint=arr(endpoint, 0), saddr=addr6(saddr),
         daddr=addr6(daddr), sport=arr(sport, 0), dport=arr(dport, 0),

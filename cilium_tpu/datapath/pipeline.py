@@ -111,8 +111,12 @@ class FullPacketBatch(NamedTuple):
     from-overlay + skb_get_tunnel_key): where ``from_overlay`` is
     nonzero, the source security identity is taken from ``tunnel_id``
     — the identity the sending node stamped into the tunnel key — not
-    re-derived from the ipcache.  Both default to None (no overlay
-    traffic in the batch)."""
+    re-derived from the ipcache.  ``mark_identity`` is the proxy-mark
+    analog (bpf_netdev.c:128-146 MARK_MAGIC_PROXY): flows re-entering
+    the datapath from the L7 proxy carry the ORIGINAL source identity
+    in the mark, so they are not re-classified (as WORLD or as the
+    proxy host) on the way to the upstream; nonzero values win over
+    the ipcache.  All three default to None."""
 
     endpoint: jnp.ndarray
     saddr: jnp.ndarray
@@ -126,6 +130,7 @@ class FullPacketBatch(NamedTuple):
     is_fragment: jnp.ndarray
     from_overlay: jnp.ndarray = None
     tunnel_id: jnp.ndarray = None
+    mark_identity: jnp.ndarray = None
 
 
 class NATResult(NamedTuple):
@@ -237,6 +242,12 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
     if pkt.from_overlay is not None:
         decap = (pkt.from_overlay != 0) & (pkt.direction == 0)
         identity = jnp.where(decap, pkt.tunnel_id, identity)
+    # Proxy re-entry: the mark carries the original source identity of
+    # a proxied flow (bpf_netdev.c:128-146) — without it the upstream
+    # leg would classify as the proxy host / WORLD.
+    if pkt.mark_identity is not None:
+        identity = jnp.where(pkt.mark_identity > 0,
+                             pkt.mark_identity, identity)
 
     # 5. Policy verdict (bpf/lib/policy.h __policy_can_access).
     vb = PacketBatch(endpoint=pkt.endpoint, identity=identity,
@@ -349,6 +360,7 @@ class FullPacketBatch6(NamedTuple):
     is_fragment: jnp.ndarray
     from_overlay: jnp.ndarray = None
     tunnel_id: jnp.ndarray = None
+    mark_identity: jnp.ndarray = None
 
 
 class LPM6Tables(NamedTuple):
@@ -436,6 +448,10 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
     if pkt.from_overlay is not None:
         decap = (pkt.from_overlay != 0) & (pkt.direction == 0)
         identity = jnp.where(decap, pkt.tunnel_id, identity)
+    if pkt.mark_identity is not None:
+        # proxy-mark re-entry (bpf_netdev.c:128-146), same as v4
+        identity = jnp.where(pkt.mark_identity > 0,
+                             pkt.mark_identity, identity)
 
     # 4. Policy verdict on the shared (family-agnostic) tables.
     vb = PacketBatch(endpoint=pkt.endpoint, identity=identity,

@@ -93,7 +93,13 @@ def parse_cnp(obj: Dict) -> List[Rule]:
     return rules
 
 
-_NS_LABELS_PREFIX = "k8s.io.cilium.k8s.namespace.labels."
+# shared with the watcher's endpoint-label side: selectors built from
+# namespaceSelector use "k8s." + this base as their key prefix, and the
+# watcher stamps endpoint labels with source k8s + the same base —
+# they must stay in lockstep or namespaceSelector policies silently
+# stop matching
+NS_LABELS_BASE = "io.cilium.k8s.namespace.labels"
+_NS_LABELS_PREFIX = f"k8s.{NS_LABELS_BASE}."
 
 
 def _parse_np_peer(peer: Dict, namespace: str):

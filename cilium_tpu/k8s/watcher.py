@@ -19,13 +19,16 @@ from typing import Dict, List, Optional
 from ..identity import RESERVED_UNMANAGED
 from ..labels import LabelArray, Label, SOURCE_K8S
 from ..node import Node, NodeAddress
-from .policy import (POLICY_LABEL_NAME, POLICY_LABEL_NAMESPACE,
-                     parse_cnp, parse_network_policy)
+from .policy import (NS_LABELS_BASE, POLICY_LABEL_NAME,
+                     POLICY_LABEL_NAMESPACE, parse_cnp,
+                     parse_network_policy)
 from .translate import endpoints_to_ips, translate_to_services
 
 # namespace meta labels carried onto pods in that namespace
-# (reference: ciliumio.PodNamespaceMetaLabels prefix)
-NS_META_PREFIX = "io.cilium.k8s.namespace.labels"
+# (reference: ciliumio.PodNamespaceMetaLabels prefix) — one constant
+# shared with the selector side (k8s/policy.py) so namespaceSelector
+# matching can't silently drift
+NS_META_PREFIX = NS_LABELS_BASE
 
 
 def _policy_key_labels(name: str, namespace: str) -> LabelArray:
@@ -91,21 +94,13 @@ class K8sWatcher:
                 self._count("cnp")
                 return
             # enforcing = every endpoint realized the revision; the
-            # reference waits via a controller — do the same async so
-            # slow builds don't block the event stream
+            # reference waits via a controller — one shared status
+            # worker drains a queue (per-event threads would pile up
+            # under CNP churn, all polling the endpoint list)
             self.cnp_status.setdefault(skey, {})[node] = {
                 "ok": True, "enforcing": False, "revision": rev,
                 "lastUpdated": time.time()}
-
-            def _wait_enforced():
-                if self.daemon.wait_for_policy_revision(rev, timeout=30):
-                    st = self.cnp_status.get(skey, {}).get(node)
-                    if st is not None and st.get("revision") == rev:
-                        st["enforcing"] = True
-                        st["lastUpdated"] = time.time()
-
-            threading.Thread(target=_wait_enforced, daemon=True,
-                             name=f"cnp-status-{name}").start()
+            self._status_queue_put(skey, node, rev)
         elif action == "deleted":
             self.daemon.policy_delete(key)
             self.cnp_status.pop(skey, None)
@@ -115,6 +110,28 @@ class K8sWatcher:
                        ) -> Dict[str, Dict]:
         """The CNP's per-node status map (Status.Nodes analog)."""
         return dict(self.cnp_status.get((namespace, name), {}))
+
+    def _status_queue_put(self, skey: tuple, node: str,
+                          rev: int) -> None:
+        import queue as _queue
+        with self._lock:
+            if not hasattr(self, "_status_q"):
+                self._status_q: "_queue.Queue" = _queue.Queue()
+                threading.Thread(target=self._status_worker,
+                                 daemon=True,
+                                 name="cnp-status").start()
+        self._status_q.put((skey, node, rev))
+
+    def _status_worker(self) -> None:
+        """Single controller draining enforcement-status work items
+        (cnpNodeStatusController analog)."""
+        while True:
+            skey, node, rev = self._status_q.get()
+            ok = self.daemon.wait_for_policy_revision(rev, timeout=30)
+            st = self.cnp_status.get(skey, {}).get(node)
+            if ok and st is not None and st.get("revision") == rev:
+                st["enforcing"] = True
+                st["lastUpdated"] = time.time()
 
     def on_network_policy(self, action: str, obj: Dict) -> None:
         meta = obj.get("metadata") or {}
@@ -152,6 +169,15 @@ class K8sWatcher:
             for p in spec.get("ports") or []:
                 self.daemon.service_delete(vip, int(p.get("port", 0)))
         else:
+            # a modified spec that drops a port must tear that
+            # frontend down, or it keeps forwarding forever
+            old = self._services.get(key) or {}
+            new_ports = {int(p.get("port", 0))
+                         for p in spec.get("ports") or []}
+            for p in old.get("ports") or []:
+                if int(p.get("port", 0)) not in new_ports:
+                    self.daemon.service_delete(
+                        old.get("vip", vip), int(p.get("port", 0)))
             self._services[key] = {"headless": False, "vip": vip,
                                    "ports": spec.get("ports") or []}
             backends = self._endpoints.get(key, [])
@@ -358,24 +384,35 @@ class K8sWatcher:
         self._count("ingress")
 
     def _ingress_target_port(self, namespace: str, svc_name: str,
-                             service_port: int) -> int:
+                             service_port: int) -> Optional[int]:
         """Resolve the backing service's targetPort for the ingress
-        servicePort (reference resolves through the service spec)."""
+        servicePort (reference resolves through the service spec).
+        None when the service is unknown — the frontend must be torn
+        down, not re-programmed with a guessed target port."""
         svc = self._services.get((namespace, svc_name))
-        if svc:
-            for p in svc.get("ports") or []:
-                if int(p.get("port", 0)) == service_port:
-                    try:
-                        return int(p.get("targetPort") or service_port)
-                    except (TypeError, ValueError):
-                        return service_port  # named port fallback
+        if not svc:
+            return None
+        for p in svc.get("ports") or []:
+            if int(p.get("port", 0)) == service_port:
+                try:
+                    return int(p.get("targetPort") or service_port)
+                except (TypeError, ValueError):
+                    return service_port  # named port fallback
         return service_port
 
     def _program_ingress(self, key: tuple) -> None:
         svc_name, port = self._ingresses[key]
         namespace = key[0]
-        backends = self._endpoints.get((namespace, svc_name), [])
         target = self._ingress_target_port(namespace, svc_name, port)
+        if target is None:
+            # backing service gone: tear the frontend down rather than
+            # forward to a guessed (wrong) pod port
+            old_port = self._ingress_ports.pop(key, None)
+            if old_port:
+                self.daemon.service_delete(self.ingress_host_ip,
+                                           old_port)
+            return
+        backends = self._endpoints.get((namespace, svc_name), [])
         self.daemon.service_upsert(
             self.ingress_host_ip, port,
             [(ip, target) for ip in backends])

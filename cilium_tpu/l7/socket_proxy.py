@@ -170,6 +170,16 @@ class SocketProxy:
         self._servers: Dict[str, asyncio.AbstractServer] = {}
         self._next_conn_id = 0
         self._lock = threading.Lock()
+        # Proxy-mark analog (bpf_netdev.c:128-146 / the reference's
+        # SO_MARK on the upstream socket): each upstream connection is
+        # registered under its full 4-tuple (local ip, local port,
+        # remote ip, remote port) with the ORIGINAL source identity, so
+        # the re-entry path can classify proxied flows as their true
+        # source instead of the proxy host.  Keyed by the 4-tuple, not
+        # the local pair alone: the kernel may reuse a local ephemeral
+        # port across sockets with distinct remotes, and a collision
+        # would let one connection's teardown erase another's live mark.
+        self.conn_marks: Dict[Tuple[str, int, str, int], int] = {}
 
     def _run(self):
         asyncio.set_event_loop(self._loop)
@@ -208,6 +218,25 @@ class SocketProxy:
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=5)
 
+    def mark_for(self, upstream_local_addr: Tuple[str, int],
+                 upstream_peer_addr: Optional[Tuple[str, int]] = None
+                 ) -> int:
+        """The identity stamped on an upstream leg — what the netdev
+        program reads back from the mark (bpf_netdev.c:128-146).
+        0 = no mark (not a proxied flow).  Pass the remote address for
+        an exact 4-tuple match; without it the first matching local
+        pair is returned (convenience for single-upstream tests)."""
+        with self._lock:
+            if upstream_peer_addr is not None:
+                return self.conn_marks.get(
+                    (upstream_local_addr[0], upstream_local_addr[1],
+                     upstream_peer_addr[0], upstream_peer_addr[1]), 0)
+            for (lip, lport, _rip, _rport), ident in \
+                    self.conn_marks.items():
+                if (lip, lport) == tuple(upstream_local_addr[:2]):
+                    return ident
+            return 0
+
     def _log(self, ctx: ListenerContext, verdict: str, proto: str,
              src_id: int, dst_id: int, info: dict) -> None:
         if self.access_log is None:
@@ -232,6 +261,16 @@ class SocketProxy:
             client_w.close()
             return
         src_id, dst_id = ctx.identities(peer)
+        # stamp the original identity on the upstream leg (SO_MARK
+        # analog) for the re-entry classification
+        up_local = up_w.get_extra_info("sockname")
+        up_peer = up_w.get_extra_info("peername")
+        mark_key = None
+        if up_local is not None and up_peer is not None:
+            mark_key = (up_local[0], up_local[1],
+                        up_peer[0], up_peer[1])
+            with self._lock:
+                self.conn_marks[mark_key] = src_id
         try:
             if ctx.parser_type == "kafka":
                 await self._pump_kafka(client_r, client_w, up_r, up_w,
@@ -243,6 +282,9 @@ class SocketProxy:
                 await self._pump_parser(client_r, client_w, up_r, up_w,
                                         ctx, peer, src_id, dst_id)
         finally:
+            if mark_key is not None:
+                with self._lock:
+                    self.conn_marks.pop(mark_key, None)
             for w in (client_w, up_w):
                 try:
                     w.close()

@@ -294,6 +294,10 @@ def test_watcher_ingress_programs_external_frontend():
     d = Daemon(config=DaemonConfig())
     w = K8sWatcher(d, ingress_host_ip="192.0.2.1")
     try:
+        w.on_service("added", {
+            "metadata": {"name": "web", "namespace": "prod"},
+            "spec": {"clusterIP": "10.96.0.30",
+                     "ports": [{"port": 8080}]}})
         w.on_endpoints("added", {
             "metadata": {"name": "web", "namespace": "prod"},
             "subsets": [{"addresses": [{"ip": "10.30.1.7"}],
@@ -302,14 +306,17 @@ def test_watcher_ingress_programs_external_frontend():
             "metadata": {"name": "web-ing", "namespace": "prod"},
             "spec": {"backend": {"serviceName": "web",
                                  "servicePort": 8080}}})
-        svcs = d.datapath.lb.services()
-        assert any(s.port == 8080 and len(s.backends) == 1
-                   for s in svcs)
+        from cilium_tpu.compiler.lpm import ipv4_to_u32
+        ing_vip = ipv4_to_u32("192.0.2.1")
+        svcs = [s for s in d.datapath.lb.services() if s.vip == ing_vip]
+        assert svcs and svcs[0].port == 8080 and \
+            len(svcs[0].backends) == 1
         w.on_ingress("deleted", {
             "metadata": {"name": "web-ing", "namespace": "prod"},
             "spec": {"backend": {"serviceName": "web",
                                  "servicePort": 8080}}})
-        assert not d.datapath.lb.services()
+        assert not [s for s in d.datapath.lb.services()
+                    if s.vip == ing_vip]
     finally:
         d.shutdown()
 
@@ -442,5 +449,48 @@ def test_watcher_label_updates_preserve_non_k8s_labels():
         srcs = {lb.source for lb in ep.labels.values()}
         assert "container" in srcs
         assert any(lb.key == "v" for lb in ep.labels.values())
+    finally:
+        d.shutdown()
+
+
+def test_watcher_service_port_removal_and_ingress_teardown():
+    """Review regressions: a modified service spec that drops a port
+    tears that frontend down, and deleting the backing service tears
+    dependent ingress frontends down instead of re-programming them
+    with a guessed target port."""
+    from cilium_tpu.compiler.lpm import ipv4_to_u32
+    d = Daemon(config=DaemonConfig())
+    w = K8sWatcher(d, ingress_host_ip="192.0.2.1")
+    try:
+        w.on_service("added", {
+            "metadata": {"name": "multi", "namespace": "prod"},
+            "spec": {"clusterIP": "10.96.0.40",
+                     "ports": [{"port": 80, "targetPort": 8080},
+                               {"port": 443, "targetPort": 8443}]}})
+        vip = ipv4_to_u32("10.96.0.40")
+        assert {s.port for s in d.datapath.lb.services()
+                if s.vip == vip} == {80, 443}
+        # modified spec drops 443
+        w.on_service("modified", {
+            "metadata": {"name": "multi", "namespace": "prod"},
+            "spec": {"clusterIP": "10.96.0.40",
+                     "ports": [{"port": 80, "targetPort": 8080}]}})
+        assert {s.port for s in d.datapath.lb.services()
+                if s.vip == vip} == {80}
+        # ingress on the service, then the service is deleted: the
+        # ingress frontend goes away too
+        w.on_ingress("added", {
+            "metadata": {"name": "ing", "namespace": "prod"},
+            "spec": {"backend": {"serviceName": "multi",
+                                 "servicePort": 80}}})
+        ing_vip = ipv4_to_u32("192.0.2.1")
+        assert [s for s in d.datapath.lb.services()
+                if s.vip == ing_vip]
+        w.on_service("deleted", {
+            "metadata": {"name": "multi", "namespace": "prod"},
+            "spec": {"clusterIP": "10.96.0.40",
+                     "ports": [{"port": 80, "targetPort": 8080}]}})
+        assert not [s for s in d.datapath.lb.services()
+                    if s.vip == ing_vip]
     finally:
         d.shutdown()
