@@ -220,6 +220,16 @@ def cmd_monitor(c: Client, args) -> int:
     if args.stats:
         _print_json(c.get("/monitor/stats"))
         return 0
+    if args.socket:
+        # true subscriber stream from a separate process: no polling,
+        # no dedupe needed — the server pushes each sample once
+        from .monitor import monitor_follow
+        host, _, port = args.socket.rpartition(":")
+        for e in monitor_follow(int(port), host=host or "127.0.0.1",
+                                replay=args.replay,
+                                drops_only=args.drops):
+            print(e["message"], flush=True)
+        return 0
     # events in one batch share a timestamp, so dedupe on the full
     # event tuple (bounded), not the timestamp alone
     seen = set()
@@ -371,6 +381,13 @@ def build_parser() -> argparse.ArgumentParser:
     mon.add_argument("--stats", action="store_true")
     mon.add_argument("-f", "--follow", action="store_true")
     mon.add_argument("--interval", type=float, default=1.0)
+    mon.add_argument("--socket", default="",
+                     help="host:port of the agent's monitor stream "
+                          "(cross-process follow, monitor/main.go "
+                          "subscriber analog)")
+    mon.add_argument("--replay", type=int, default=0,
+                     help="with --socket: replay the last N ring "
+                          "samples before following")
 
     cfgp = sub.add_parser("config", help="daemon options")
     cfgp.add_argument("options", nargs="*", help="Option=value")

@@ -40,6 +40,7 @@ from ..policy.api import Rule
 from ..policy.repository import Repository
 from ..policy.trace import SearchContext, traced_context
 from ..proxy import ProxyManager
+from ..utils.lock import RMutex
 from ..utils.controller import ControllerManager, ControllerParams
 from ..utils.metrics import (IDENTITY_COUNT, POLICY_COUNT,
                              POLICY_IMPORT_ERRORS, POLICY_REVISION,
@@ -127,7 +128,7 @@ class Daemon:
         # rule object -> prefixes it currently holds refs for
         self._rule_prefixes: Dict[int, List[str]] = {}
         self._fqdn_rules: List[Rule] = []
-        self._lock = threading.RLock()
+        self._lock = RMutex("daemon")
 
         # endpoint regeneration pipeline (daemon.go:1133 builders)
         self.endpoints = EndpointManager(
@@ -625,6 +626,18 @@ class Daemon:
             time.sleep(0.01)
         return applied() and self.endpoints.wait_for_quiesce(0.0)
 
+    # ----------------------------------------------------- monitor wire
+
+    def serve_monitor(self, port: int = 0):
+        """Serve the monitor event stream to subscriber processes
+        (monitor/main.go:81-119 unix-socket fan-out analog); the CLI's
+        ``monitor --socket`` follows from a separate process."""
+        from ..monitor import MonitorServer
+        if getattr(self, "_monitor_server", None) is None:
+            self._monitor_server = MonitorServer(self.monitor,
+                                                 port=port).start()
+        return self._monitor_server
+
     # -------------------------------------------------------- xDS wire
 
     def serve_xds(self, port: int = 0):
@@ -680,6 +693,8 @@ class Daemon:
         return self._xds_server
 
     def shutdown(self) -> None:
+        if getattr(self, "_monitor_server", None) is not None:
+            self._monitor_server.shutdown()
         if getattr(self, "_xds_server", None) is not None:
             self._xds_server.shutdown()
         self.endpoints.shutdown()

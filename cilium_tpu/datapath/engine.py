@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import functools
 import threading
+
+from ..utils.lock import Mutex
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -45,7 +47,7 @@ class Datapath:
         # mutual exclusion the periodic GC controller can donate the
         # state out from under an in-flight process() (deleted-array
         # crash)
-        self._lock = threading.Lock()
+        self._lock = Mutex("datapath")
         self.prefilter = PreFilter()
         self.lb = LoadBalancer()
         self.ct = ConntrackTable(slots=ct_slots, max_probe=ct_probe)

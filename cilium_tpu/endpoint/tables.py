@@ -12,6 +12,8 @@ full rebuild + swap — the double-buffered "generation" path.
 from __future__ import annotations
 
 import threading
+
+from ..utils.lock import RMutex
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -58,7 +60,7 @@ class DeviceTableManager:
 
     def __init__(self, initial_endpoints: int = 8,
                  initial_slots: int = MIN_SLOTS, max_load: float = 0.5):
-        self._lock = threading.RLock()
+        self._lock = RMutex("table-manager")
         self.max_load = max_load
         # hash tables are always pow2-sized; normalize up front so row
         # rebuilds land on exactly self.slots

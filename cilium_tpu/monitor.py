@@ -147,3 +147,139 @@ class MonitorHub:
             self._counts = {}
             self._bytes = {}
             self.lost = 0
+
+
+# ---------------------------------------------------------------------------
+# Cross-process fan-out (monitor/main.go:81-119)
+# ---------------------------------------------------------------------------
+#
+# The reference's cilium-node-monitor serves decoded events to N
+# subscriber processes over a unix socket; slow subscribers get a lossy
+# bounded queue, not backpressure into the datapath.  Here the hub is
+# served over TCP with the kvstore framing: one writer thread + bounded
+# queue per subscriber, overflow counted and dropped.
+
+def _monitor_event_dict(ev: MonitorEvent) -> Dict:
+    return {"timestamp": ev.timestamp, "code": ev.code,
+            "endpoint": ev.endpoint, "identity": ev.identity,
+            "dport": ev.dport, "proto": ev.proto, "length": ev.length,
+            "message": ev.describe()}
+
+
+class MonitorServer:
+    """Serve a MonitorHub's event stream to subscriber processes."""
+
+    def __init__(self, hub: MonitorHub, host: str = "127.0.0.1",
+                 port: int = 0, queue_depth: int = 1024):
+        import socketserver
+        from .kvstore.server import recv_frame, send_frame
+        self.hub = hub
+        self.queue_depth = queue_depth
+        outer = self
+
+        class _Conn(socketserver.BaseRequestHandler):
+            def setup(self):
+                import queue as _q
+                self.q: "_q.Queue" = _q.Queue(maxsize=outer.queue_depth)
+                self.dropped = 0
+                self.unsub = None
+
+            def handle(self):
+                import queue as _q
+                # replay the ring, then follow live events
+                req = recv_frame(self.request)
+                if not req or req.get("op") != "follow":
+                    return
+                n = int(req.get("replay", 0))
+                drops_only = bool(req.get("drops", False))
+                # filter-before-truncate: replay=N means the last N
+                # *matching* samples (hub.tail owns that semantics)
+                replay = outer.hub.tail(n, drops_only=drops_only) \
+                    if n else []
+                for ev in replay:
+                    try:
+                        send_frame(self.request,
+                                   _monitor_event_dict(ev))
+                    except OSError:
+                        return
+
+                def on_event(ev: MonitorEvent) -> None:
+                    if drops_only and not ev.is_drop:
+                        return
+                    try:
+                        self.q.put_nowait(ev)
+                    except _q.Full:
+                        self.dropped += 1  # lossy, never backpressures
+
+                self.unsub = outer.hub.subscribe(on_event)
+                last_send = time.time()
+                while not outer._stop.is_set():
+                    try:
+                        ev = self.q.get(timeout=0.5)
+                    except _q.Empty:
+                        # idle ping: the only way to notice a client
+                        # that vanished while no events flow — without
+                        # it the handler thread + hub subscription
+                        # leak forever
+                        if time.time() - last_send > 2.0:
+                            try:
+                                send_frame(self.request, {"ping": 1})
+                                last_send = time.time()
+                            except OSError:
+                                return
+                        continue
+                    try:
+                        send_frame(self.request,
+                                   _monitor_event_dict(ev))
+                        last_send = time.time()
+                    except OSError:
+                        return
+
+            def finish(self):
+                if self.unsub is not None:
+                    self.unsub()
+
+        class _TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._stop = threading.Event()
+        self._tcp = _TCP((host, port), _Conn)
+        self.host, self.port = self._tcp.server_address
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True,
+                                        name="monitor-server")
+
+    def start(self) -> "MonitorServer":
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()  # handler loops drain within their poll tick
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+
+def monitor_follow(port: int, host: str = "127.0.0.1",
+                   replay: int = 0, drops_only: bool = False):
+    """Generator of event dicts from a MonitorServer — the subscriber
+    half (cilium monitor following from a separate process)."""
+    import socket as _socket
+    from .kvstore.server import recv_frame, send_frame
+    sock = _socket.create_connection((host, port), timeout=10)
+    # clear the connect timeout: a quiet stream must block, not
+    # silently end after 10 idle seconds (recv timeout would surface
+    # as OSError -> recv_frame None -> clean-close ambiguity)
+    sock.settimeout(None)
+    try:
+        send_frame(sock, {"op": "follow", "replay": replay,
+                          "drops": drops_only})
+        while True:
+            msg = recv_frame(sock)
+            if msg is None:
+                return
+            if "ping" in msg:
+                continue  # server liveness probe, not an event
+            yield msg
+    finally:
+        sock.close()

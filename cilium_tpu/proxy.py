@@ -13,6 +13,8 @@ a registered custom parser) plus an access-log stream
 from __future__ import annotations
 
 import threading
+
+from .utils.lock import RMutex
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -103,7 +105,7 @@ class ProxyManager:
 
     def __init__(self, port_min: int = PROXY_PORT_MIN,
                  port_max: int = PROXY_PORT_MAX):
-        self._lock = threading.RLock()
+        self._lock = RMutex("proxy-manager")
         self._redirects: Dict[str, Redirect] = {}
         self._ports_in_use: set = set()
         self._next_port = port_min

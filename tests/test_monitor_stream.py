@@ -1,0 +1,207 @@
+"""Monitor cross-process fan-out + deadlock-detecting locks.
+
+Reference parity:
+  * monitor/main.go:81-119 — the node monitor fans decoded datapath
+    events out to subscriber processes over a socket with lossy
+    bounded per-subscriber queues; `cilium monitor` follows from a
+    separate process;
+  * pkg/lock/lock.go:21-40 — Mutex/RWMutex wrappers with deadlock
+    detection: a wait past the detector timeout reports both stacks
+    instead of hanging the agent forever.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cilium_tpu.utils.lock as lock_mod
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.monitor import MonitorHub, MonitorServer, monitor_follow
+from cilium_tpu.utils.lock import (Mutex, PotentialDeadlockError, RMutex,
+                                   RWMutex)
+from cilium_tpu.utils.option import DaemonConfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _ingest(hub, codes):
+    n = len(codes)
+    hub.ingest_batch(np.asarray(codes, np.int32),
+                     np.zeros(n, np.int32),
+                     np.full(n, 777, np.int32),
+                     np.full(n, 80, np.int32),
+                     np.full(n, 6, np.int32),
+                     np.full(n, 100, np.int32))
+
+
+# ----------------------------------------------------- stream in-proc
+
+def test_monitor_stream_replay_and_follow():
+    hub = MonitorHub()
+    _ingest(hub, [0, -130])  # one trace, one drop (ringed)
+    server = MonitorServer(hub, port=0).start()
+    got = []
+    done = threading.Event()
+
+    def consume():
+        for e in monitor_follow(server.port, replay=100):
+            got.append(e)
+            if len(got) >= 4:
+                done.set()
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)          # subscriber registered
+    _ingest(hub, [0, -133])  # live events after subscribe
+    assert done.wait(10), got
+    codes = [e["code"] for e in got]
+    assert set(codes[:2]) == {0, -130}   # ring replay (drops first)
+    assert set(codes[2:]) == {0, -133}   # live follow
+    assert all("message" in e for e in got)
+    server.shutdown()
+
+
+def test_monitor_stream_drops_only():
+    hub = MonitorHub()
+    server = MonitorServer(hub, port=0).start()
+    got = []
+    done = threading.Event()
+
+    def consume():
+        for e in monitor_follow(server.port, drops_only=True):
+            got.append(e)
+            done.set()
+            return
+
+    threading.Thread(target=consume, daemon=True).start()
+    time.sleep(0.3)
+    _ingest(hub, [0, 0, 0])      # traces: filtered out
+    _ingest(hub, [-130])         # drop: delivered
+    assert done.wait(10)
+    assert got[0]["code"] == -130
+    server.shutdown()
+
+
+# ------------------------------------------------- cli cross-process
+
+def test_cli_monitor_follows_from_separate_process():
+    """The VERDICT cycle: a REAL `cilium monitor --socket` process
+    follows the agent's event stream (monitor/main.go:81-119)."""
+    d = Daemon(config=DaemonConfig())
+    server = d.serve_monitor()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cilium_tpu.cli", "monitor",
+         "--socket", f"127.0.0.1:{server.port}"],
+        stdout=subprocess.PIPE, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    try:
+        # wait until the CLI's subscription is registered (its jax
+        # import alone can take seconds)
+        deadline = time.time() + 30
+        while not d.monitor._subscribers and time.time() < deadline:
+            time.sleep(0.1)
+        assert d.monitor._subscribers, "CLI never subscribed"
+        _ingest(d.monitor, [-130, 0])
+        lines = [proc.stdout.readline(), proc.stdout.readline()]
+        blob = "".join(lines)
+        assert "DROP" in blob and "Policy denied" in blob, blob
+        assert "TRACE" in blob, blob
+    finally:
+        proc.kill()
+        d.shutdown()
+
+
+# ------------------------------------------------ deadlock detection
+
+@pytest.fixture()
+def short_timeout():
+    """Enable the lockdebug build-tag analog with a short detector."""
+    old_t, old_d = lock_mod.DEADLOCK_TIMEOUT, lock_mod.DEBUG
+    lock_mod.DEADLOCK_TIMEOUT = 0.5
+    lock_mod.DEBUG = True
+    yield
+    lock_mod.DEADLOCK_TIMEOUT = old_t
+    lock_mod.DEBUG = old_d
+
+
+def test_mutex_normal_operation(short_timeout):
+    m = Mutex("m")
+    with m:
+        assert m.locked()
+    assert not m.locked()
+    r = RMutex("r")
+    with r:
+        with r:  # reentrant
+            pass
+
+
+def test_mutex_passthrough_when_lockdebug_off():
+    """Default build: plain sync.Mutex semantics, no stack capture."""
+    assert not lock_mod.DEBUG
+    m = Mutex("m")
+    with m:
+        assert m._owner is None  # no bookkeeping on the hot path
+    r = RMutex("r")
+    with r:
+        with r:
+            pass
+
+
+def test_mutex_deadlock_detection_reports_both_stacks(short_timeout):
+    m = Mutex("test-lock")
+    holder_ready = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with m:
+            holder_ready.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder, daemon=True, name="the-holder")
+    t.start()
+    holder_ready.wait(5)
+    with pytest.raises(PotentialDeadlockError) as exc:
+        m.acquire()
+    msg = str(exc.value)
+    assert "test-lock" in msg
+    assert "waiter stack" in msg
+    assert "the-holder" in msg  # who holds it
+    release.set()
+
+
+def test_rwmutex_readers_and_writer_preference(short_timeout):
+    rw = RWMutex("rw")
+    with rw.read_locked():
+        with rw.read_locked():
+            pass  # concurrent readers fine
+
+    # writer deadlock detection: a stuck reader trips the detector
+    stuck = threading.Event()
+
+    def reader():
+        rw.acquire_read()
+        stuck.set()
+        time.sleep(5)
+
+    threading.Thread(target=reader, daemon=True).start()
+    stuck.wait(5)
+    with pytest.raises(PotentialDeadlockError):
+        rw.acquire_write()
+
+
+def test_daemon_structures_use_debug_locks():
+    d = Daemon(config=DaemonConfig())
+    try:
+        assert isinstance(d._lock, RMutex)
+        assert isinstance(d.datapath._lock, Mutex)
+        assert isinstance(d.table_mgr._lock, RMutex)
+        assert isinstance(d.proxy._lock, RMutex)
+    finally:
+        d.shutdown()
