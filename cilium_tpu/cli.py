@@ -224,7 +224,11 @@ def cmd_monitor(c: Client, args) -> int:
         # true subscriber stream from a separate process: no polling,
         # no dedupe needed — the server pushes each sample once
         from .monitor import monitor_follow
-        host, _, port = args.socket.rpartition(":")
+        host, sep, port = args.socket.rpartition(":")
+        if not sep or not port.isdigit():
+            print(f"monitor: --socket expects host:port, got "
+                  f"{args.socket!r}", file=sys.stderr)
+            return 2
         for e in monitor_follow(int(port), host=host or "127.0.0.1",
                                 replay=args.replay,
                                 drops_only=args.drops):
@@ -267,6 +271,17 @@ def cmd_config(c: Client, args) -> int:
 
 def cmd_metrics(c: Client, args) -> int:
     print(c.get("/metrics", raw=True), end="")
+    return 0
+
+
+def cmd_migrate_state(c: Client, args) -> int:
+    """Standalone state migration (bpf/cilium-map-migrate.c analog:
+    run around an agent upgrade, before the new agent restores)."""
+    from .migrate import CHECKPOINT_VERSION, migrate_state_dir
+    migrated, current = migrate_state_dir(
+        args.state_dir, keep_backup=not args.no_backup)
+    print(f"migrated {migrated} checkpoint(s) to "
+          f"v{CHECKPOINT_VERSION}; {current} already current")
     return 0
 
 
@@ -394,6 +409,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("metrics", help="Prometheus metrics dump")
 
+    ms = sub.add_parser("migrate-state",
+                        help="upgrade endpoint checkpoints across "
+                             "agent versions (cilium-map-migrate "
+                             "analog)")
+    ms.add_argument("state_dir")
+    ms.add_argument("--no-backup", action="store_true")
+
     bt = sub.add_parser("bugtool", help="archive agent state for a bug report")
     bt.add_argument("-o", "--output", default="")
 
@@ -418,6 +440,7 @@ COMMANDS = {
     "prefilter": cmd_prefilter, "monitor": cmd_monitor,
     "config": cmd_config, "metrics": cmd_metrics,
     "bugtool": cmd_bugtool, "cni": cmd_cni,
+    "migrate-state": cmd_migrate_state,
 }
 
 

@@ -40,6 +40,7 @@ from ..policy.api import Rule
 from ..policy.repository import Repository
 from ..policy.trace import SearchContext, traced_context
 from ..proxy import ProxyManager
+from ..migrate import MigrationError
 from ..utils.lock import RMutex
 from ..utils.controller import ControllerManager, ControllerParams
 from ..utils.metrics import (IDENTITY_COUNT, POLICY_COUNT,
@@ -473,7 +474,9 @@ class Daemon:
                 with open(os.path.join(state_dir, fname)) as f:
                     snap = json.load(f)
                 ep = Endpoint.restore(snap)
-            except (OSError, ValueError, KeyError):
+            except (OSError, ValueError, KeyError, MigrationError):
+                # one unmigratable checkpoint (e.g. from a newer agent)
+                # must not block restoring the rest
                 continue
             ep.table_slot = self.table_mgr.attach(ep.id)
             self.endpoints.insert(ep)

@@ -270,8 +270,11 @@ class Endpoint:
         """Serializable endpoint state (the writeHeaderfile analog:
         everything needed to restore the endpoint after agent restart,
         daemon/state.go)."""
+        from ..migrate import CHECKPOINT_VERSION
         with self._lock:
             return {
+                "version": CHECKPOINT_VERSION,
+                "family": 4,
                 "id": self.id,
                 "ipv4": self.ipv4,
                 "container_name": self.container_name,
@@ -304,7 +307,11 @@ class Endpoint:
                 opts: Optional[IntOptions] = None) -> "Endpoint":
         """Rebuild an endpoint from a checkpoint (daemon/state.go
         restoreOldEndpoints). Restored endpoints start in RESTORING and
-        need a regeneration to become READY with fresh policy."""
+        need a regeneration to become READY with fresh policy.  Old
+        checkpoint versions are migrated forward first
+        (cilium-map-migrate analog, migrate.py)."""
+        from ..migrate import migrate_snapshot
+        snapshot = migrate_snapshot(snapshot)
         ep = cls(endpoint_id=snapshot["id"], ipv4=snapshot.get("ipv4", ""),
                  container_name=snapshot.get("container_name", ""),
                  labels=Labels.from_model(snapshot.get("labels", [])),

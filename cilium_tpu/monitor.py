@@ -192,16 +192,6 @@ class MonitorServer:
                     return
                 n = int(req.get("replay", 0))
                 drops_only = bool(req.get("drops", False))
-                # filter-before-truncate: replay=N means the last N
-                # *matching* samples (hub.tail owns that semantics)
-                replay = outer.hub.tail(n, drops_only=drops_only) \
-                    if n else []
-                for ev in replay:
-                    try:
-                        send_frame(self.request,
-                                   _monitor_event_dict(ev))
-                    except OSError:
-                        return
 
                 def on_event(ev: MonitorEvent) -> None:
                     if drops_only and not ev.is_drop:
@@ -211,7 +201,23 @@ class MonitorServer:
                     except _q.Full:
                         self.dropped += 1  # lossy, never backpressures
 
+                # subscribe BEFORE snapshotting the ring: events
+                # ingested while the replay is on the wire land in the
+                # queue instead of vanishing in the gap; the queue is
+                # then deduped against what the replay already sent
+                # (ring and queue share the same event objects)
                 self.unsub = outer.hub.subscribe(on_event)
+                # filter-before-truncate: replay=N means the last N
+                # *matching* samples (hub.tail owns that semantics)
+                replay = outer.hub.tail(n, drops_only=drops_only) \
+                    if n else []
+                replayed_ids = {id(ev) for ev in replay}
+                for ev in replay:
+                    try:
+                        send_frame(self.request,
+                                   _monitor_event_dict(ev))
+                    except OSError:
+                        return
                 last_send = time.time()
                 while not outer._stop.is_set():
                     try:
@@ -228,6 +234,9 @@ class MonitorServer:
                             except OSError:
                                 return
                         continue
+                    if id(ev) in replayed_ids:
+                        replayed_ids.discard(id(ev))
+                        continue  # already sent in the replay
                     try:
                         send_frame(self.request,
                                    _monitor_event_dict(ev))

@@ -65,11 +65,6 @@ class _DebugLockBase:
 
     def acquire(self, blocking: bool = True,
                 timeout: float = -1) -> bool:
-        if not DEBUG:
-            # lockdebug off: sync.Mutex semantics, zero overhead
-            if timeout >= 0:
-                return self._inner.acquire(blocking, timeout)
-            return self._inner.acquire(blocking)
         if not blocking or timeout >= 0:
             got = self._inner.acquire(blocking, timeout)
             if got:
@@ -89,9 +84,6 @@ class _DebugLockBase:
             self._owner_stack = _stack()
 
     def release(self) -> None:
-        if not DEBUG:
-            self._inner.release()
-            return
         self._depth -= 1
         if self._depth == 0:
             self._owner = None
@@ -110,18 +102,29 @@ class _DebugLockBase:
         return self._owner is not None
 
 
-class Mutex(_DebugLockBase):
+class _DebugMutex(_DebugLockBase):
     """threading.Lock with deadlock detection (lock.go Mutex)."""
 
     def __init__(self, name: str = ""):
         super().__init__(name, reentrant=False)
 
 
-class RMutex(_DebugLockBase):
+class _DebugRMutex(_DebugLockBase):
     """threading.RLock with deadlock detection."""
 
     def __init__(self, name: str = ""):
         super().__init__(name, reentrant=True)
+
+
+def Mutex(name: str = ""):  # noqa: N802 — type-factory, lock.go Mutex
+    """The build-tag factory: a raw C-level threading.Lock in the
+    default build (truly zero overhead on the hot path), the detecting
+    wrapper under lockdebug."""
+    return _DebugMutex(name) if DEBUG else threading.Lock()
+
+
+def RMutex(name: str = ""):  # noqa: N802 — type-factory
+    return _DebugRMutex(name) if DEBUG else threading.RLock()
 
 
 class RWMutex:
@@ -136,6 +139,10 @@ class RWMutex:
         self.name = name or f"rwlock@{id(self):x}"
         self._cond = threading.Condition()
         self._readers = 0
+        # per-thread read depth: a thread already holding a read lock
+        # bypasses the waiting-writer gate on re-acquisition, or the
+        # nested-read / waiting-writer pair would deadlock each other
+        self._read_counts: dict = {}
         self._writer: Optional[str] = None
         self._writer_stack: Optional[str] = None
         self._writers_waiting = 0
@@ -166,7 +173,14 @@ class RWMutex:
     # ---------------------------------------------------------- readers
 
     def acquire_read(self) -> None:
+        me = threading.get_ident()
         with self._cond:
+            if self._read_counts.get(me, 0) > 0:
+                # reentrant read: already inside, never gate on
+                # waiting writers (they're gated on US finishing)
+                self._read_counts[me] += 1
+                self._readers += 1
+                return
             ok = self._cond.wait_for(
                 lambda: self._writer is None and
                 self._writers_waiting == 0,
@@ -176,10 +190,17 @@ class RWMutex:
                     self.name, _stack(), self._writer,
                     self._writer_stack)
             self._readers += 1
+            self._read_counts[me] = 1
 
     def release_read(self) -> None:
+        me = threading.get_ident()
         with self._cond:
             self._readers -= 1
+            n = self._read_counts.get(me, 1) - 1
+            if n <= 0:
+                self._read_counts.pop(me, None)
+            else:
+                self._read_counts[me] = n
             if self._readers == 0:
                 self._cond.notify_all()
 

@@ -1,0 +1,132 @@
+"""Checkpoint migration across agent versions.
+
+Reference: bpf/cilium-map-migrate.c (584 LoC) + test/k8sT/Updates.go —
+pinned state must survive agent upgrades via explicit layout
+migration, and a downgrade must fail loudly rather than mis-parse.
+Here the pinned-map analog is the endpoint checkpoint (ep_*.json);
+device tables are derived state and rebuilt, so the checkpoints are
+the whole migration surface.
+"""
+
+import json
+import os
+
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.endpoint import Endpoint
+from cilium_tpu.migrate import (CHECKPOINT_VERSION, MigrationError,
+                                migrate_snapshot, migrate_state_dir)
+from cilium_tpu.policy.mapstate import PolicyKey
+from cilium_tpu.utils.option import DaemonConfig
+
+V0 = {  # earliest layout: packed-string realized map, no version
+    "id": 7,
+    "ipv4": "10.9.0.7",
+    "labels": ["k8s:app=old"],
+    "state": "ready",
+    "policy_revision": 3,
+    "identity": 1234,
+    "realized": {"1234:80:6:0": 0, "1234:443:6:0": 15001},
+}
+
+V1 = {  # dict entries, still unversioned
+    "id": 8,
+    "ipv4": "10.9.0.8",
+    "labels": ["k8s:app=mid"],
+    "state": "ready",
+    "policy_revision": 4,
+    "identity": 1235,
+    "realized": [{"identity": 1235, "dest_port": 53, "nexthdr": 17,
+                  "direction": 0, "proxy_port": 0}],
+}
+
+
+def test_migrate_v0_chain():
+    out = migrate_snapshot(dict(V0))
+    assert out["version"] == CHECKPOINT_VERSION
+    assert out["family"] == 4
+    entries = {(e["identity"], e["dest_port"]): e["proxy_port"]
+               for e in out["realized"]}
+    assert entries == {(1234, 80): 0, (1234, 443): 15001}
+
+
+def test_migrate_v1_and_idempotent():
+    out = migrate_snapshot(dict(V1))
+    assert out["version"] == CHECKPOINT_VERSION
+    assert migrate_snapshot(dict(out)) == out  # current is a no-op
+
+
+def test_newer_version_refused():
+    with pytest.raises(MigrationError):
+        migrate_snapshot({"version": CHECKPOINT_VERSION + 1, "id": 1})
+
+
+def test_restore_migrates_old_snapshots():
+    ep = Endpoint.restore(dict(V0))
+    assert ep.id == 7
+    key = PolicyKey(identity=1234, dest_port=443, nexthdr=6, direction=0)
+    assert ep.realized[key].proxy_port == 15001
+    # current-format roundtrip still carries the version stamp
+    ep2 = Endpoint.restore(ep.checkpoint())
+    assert ep2.checkpoint()["version"] == CHECKPOINT_VERSION
+
+
+def test_migrate_state_dir_in_place(tmp_path):
+    d = str(tmp_path)
+    for name, snap in (("ep_7.json", V0), ("ep_8.json", V1)):
+        with open(os.path.join(d, name), "w") as f:
+            json.dump(snap, f)
+    # a current-format file and a garbage file round out the dir
+    cur = migrate_snapshot(dict(V1))
+    cur["id"] = 9
+    with open(os.path.join(d, "ep_9.json"), "w") as f:
+        json.dump(cur, f)
+    with open(os.path.join(d, "ep_bad.json"), "w") as f:
+        f.write("{not json")
+
+    migrated, current = migrate_state_dir(d)
+    assert (migrated, current) == (2, 1)
+    for name in ("ep_7.json", "ep_8.json", "ep_9.json"):
+        with open(os.path.join(d, name)) as f:
+            assert json.load(f)["version"] == CHECKPOINT_VERSION
+    assert os.path.exists(os.path.join(d, "ep_7.json.bak"))
+    # idempotent second run
+    assert migrate_state_dir(d) == (0, 3)
+
+
+def test_daemon_restores_across_versions(tmp_path):
+    """The Updates.go scenario: a state dir written by older agent
+    versions restores into a new agent; an unknown future version is
+    skipped without blocking the rest."""
+    state = str(tmp_path / "state")
+    os.makedirs(state)
+    with open(os.path.join(state, "ep_7.json"), "w") as f:
+        json.dump(V0, f)
+    with open(os.path.join(state, "ep_8.json"), "w") as f:
+        json.dump(V1, f)
+    with open(os.path.join(state, "ep_99.json"), "w") as f:
+        json.dump({"version": 99, "id": 99}, f)
+
+    d = Daemon(config=DaemonConfig(state_dir=state))
+    try:
+        n = d.restore_endpoints()
+        assert n == 2
+        assert d.endpoints.lookup(7) is not None
+        assert d.endpoints.lookup(8) is not None
+        assert d.endpoints.lookup(99) is None
+        d.wait_for_policy_revision()
+    finally:
+        d.shutdown()
+
+
+def test_cli_migrate_state(tmp_path, capsys):
+    from cilium_tpu.cli import main
+    d = str(tmp_path)
+    with open(os.path.join(d, "ep_7.json"), "w") as f:
+        json.dump(V0, f)
+    assert main(["migrate-state", d]) == 0
+    out = capsys.readouterr().out
+    assert "migrated 1" in out
+    with open(os.path.join(d, "ep_7.json")) as f:
+        assert json.load(f)["version"] == CHECKPOINT_VERSION

@@ -142,13 +142,16 @@ def test_mutex_normal_operation(short_timeout):
             pass
 
 
-def test_mutex_passthrough_when_lockdebug_off():
-    """Default build: plain sync.Mutex semantics, no stack capture."""
+def test_mutex_factory_passthrough_when_lockdebug_off():
+    """Default build: the factory hands back the raw C-level lock —
+    the build-tag semantics, zero wrapper overhead on the hot path."""
     assert not lock_mod.DEBUG
     m = Mutex("m")
-    with m:
-        assert m._owner is None  # no bookkeeping on the hot path
+    assert isinstance(m, type(threading.Lock()))
     r = RMutex("r")
+    assert isinstance(r, type(threading.RLock()))
+    with m:
+        pass
     with r:
         with r:
             pass
@@ -176,11 +179,42 @@ def test_mutex_deadlock_detection_reports_both_stacks(short_timeout):
     release.set()
 
 
+def test_rwmutex_nested_read_survives_waiting_writer(short_timeout):
+    """A reentrant read while a writer waits must NOT deadlock: the
+    inner read bypasses the writers_waiting gate (the writer is gated
+    on this very thread finishing)."""
+    rw = RWMutex("rw")
+    in_read = threading.Event()
+    writer_waiting = threading.Event()
+    ok = threading.Event()
+
+    def nested_reader():
+        with rw.read_locked():
+            in_read.set()
+            writer_waiting.wait(5)
+            time.sleep(0.1)  # writer is parked in acquire_write now
+            with rw.read_locked():   # must not block
+                ok.set()
+
+    def writer():
+        in_read.wait(5)
+        writer_waiting.set()
+        try:
+            rw.acquire_write()
+            rw.release_write()
+        except PotentialDeadlockError:
+            pass
+
+    threading.Thread(target=nested_reader, daemon=True).start()
+    threading.Thread(target=writer, daemon=True).start()
+    assert ok.wait(5), "nested read deadlocked against waiting writer"
+
+
 def test_rwmutex_readers_and_writer_preference(short_timeout):
     rw = RWMutex("rw")
     with rw.read_locked():
         with rw.read_locked():
-            pass  # concurrent readers fine
+            pass  # reentrant readers fine
 
     # writer deadlock detection: a stuck reader trips the detector
     stuck = threading.Event()
@@ -196,12 +230,15 @@ def test_rwmutex_readers_and_writer_preference(short_timeout):
         rw.acquire_write()
 
 
-def test_daemon_structures_use_debug_locks():
+def test_daemon_structures_use_debug_locks(short_timeout):
+    """Under lockdebug, the daemon's core structures get detecting
+    locks from the factory (default build: raw locks, zero cost)."""
+    from cilium_tpu.utils.lock import _DebugMutex, _DebugRMutex
     d = Daemon(config=DaemonConfig())
     try:
-        assert isinstance(d._lock, RMutex)
-        assert isinstance(d.datapath._lock, Mutex)
-        assert isinstance(d.table_mgr._lock, RMutex)
-        assert isinstance(d.proxy._lock, RMutex)
+        assert isinstance(d._lock, _DebugRMutex)
+        assert isinstance(d.datapath._lock, _DebugMutex)
+        assert isinstance(d.table_mgr._lock, _DebugRMutex)
+        assert isinstance(d.proxy._lock, _DebugRMutex)
     finally:
         d.shutdown()
