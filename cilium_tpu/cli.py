@@ -278,10 +278,14 @@ def cmd_migrate_state(c: Client, args) -> int:
     """Standalone state migration (bpf/cilium-map-migrate.c analog:
     run around an agent upgrade, before the new agent restores)."""
     from .migrate import CHECKPOINT_VERSION, migrate_state_dir
-    migrated, current = migrate_state_dir(
+    migrated, current, skipped = migrate_state_dir(
         args.state_dir, keep_backup=not args.no_backup)
     print(f"migrated {migrated} checkpoint(s) to "
           f"v{CHECKPOINT_VERSION}; {current} already current")
+    if skipped:
+        print(f"SKIPPED {len(skipped)} unmigratable checkpoint(s): "
+              f"{', '.join(skipped)}", file=sys.stderr)
+        return 1
     return 0
 
 

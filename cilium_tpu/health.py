@@ -120,3 +120,84 @@ class HealthProber:
             self._controllers.remove_all()
         else:
             self._controllers.remove_controller("health-prober")
+
+
+# ---------------------------------------------------------------------------
+# Real-socket transport (cilium-health's probe endpoints)
+# ---------------------------------------------------------------------------
+#
+# The reference runs cilium-health as a per-node responder; the prober
+# issues ICMP echo + an HTTP GET against it (prober.go:139,229).  The
+# TCP analogs: the "icmp" probe is a bare connect (reachability), the
+# "http" probe is a ping/pong round trip through the responder.
+
+class HealthResponder:
+    """Per-node probe endpoint (cilium-health listener analog)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import socketserver
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                # read to the newline delimiter: TCP has no message
+                # boundaries, a segmented "ping\n" must still pong
+                try:
+                    buf = b""
+                    while b"\n" not in buf and len(buf) < 64:
+                        chunk = self.request.recv(64)
+                        if not chunk:
+                            return
+                        buf += chunk
+                    if buf.startswith(b"ping"):
+                        self.request.sendall(b"pong\n")
+                except OSError:
+                    pass
+
+        class _TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = _TCP((host, port), _Handler)
+        self.host, self.port = self._tcp.server_address
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True,
+                                        name="health-responder")
+
+    def start(self) -> "HealthResponder":
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+
+def make_tcp_probe(port_of: Callable[[str], int],
+                   timeout: float = 2.0):
+    """A probe_fn over real sockets.  ``port_of(ip)`` maps a node IP
+    to its health responder port (the reference derives it from the
+    health endpoint's address)."""
+    import socket as _socket
+
+    def probe(kind: str, ip: str):
+        port = port_of(ip)
+        t0 = time.time()
+        try:
+            with _socket.create_connection((ip, port),
+                                           timeout=timeout) as s:
+                if kind == PROBE_HTTP:
+                    s.settimeout(timeout)
+                    s.sendall(b"ping\n")
+                    buf = b""
+                    while b"\n" not in buf and len(buf) < 16:
+                        chunk = s.recv(16)
+                        if not chunk:
+                            break
+                        buf += chunk
+                    if not buf.startswith(b"pong"):
+                        return False, time.time() - t0
+                return True, time.time() - t0
+        except OSError:
+            return False, time.time() - t0
+
+    return probe

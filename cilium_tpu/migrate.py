@@ -81,31 +81,46 @@ MIGRATIONS: Dict[int, Callable[[Dict], Dict]] = {
 
 def migrate_snapshot(snapshot: Dict) -> Dict:
     """Upgrade a checkpoint to CHECKPOINT_VERSION (no-op when
-    current).  Raises MigrationError for unknown/newer versions."""
-    version = _detect_version(snapshot)
-    if version > CHECKPOINT_VERSION:
-        raise MigrationError(
-            f"checkpoint version {version} is newer than this agent's "
-            f"{CHECKPOINT_VERSION}; refusing to guess at its layout")
-    while version < CHECKPOINT_VERSION:
-        step = MIGRATIONS.get(version)
-        if step is None:
-            raise MigrationError(f"no migration from version {version}")
-        snapshot = step(snapshot)
-        version = _detect_version(snapshot) if "version" not in snapshot \
-            else int(snapshot["version"])
-    return snapshot
+    current).  Raises MigrationError for unknown/newer versions AND
+    for corrupt snapshots — malformed data must surface as a
+    migration failure the callers' skip-one-file handling catches,
+    not as a stray TypeError that aborts the whole restore."""
+    try:
+        version = _detect_version(snapshot)
+        if version > CHECKPOINT_VERSION:
+            raise MigrationError(
+                f"checkpoint version {version} is newer than this "
+                f"agent's {CHECKPOINT_VERSION}; refusing to guess at "
+                f"its layout")
+        while version < CHECKPOINT_VERSION:
+            step = MIGRATIONS.get(version)
+            if step is None:
+                raise MigrationError(
+                    f"no migration from version {version}")
+            snapshot = step(snapshot)
+            version = _detect_version(snapshot) \
+                if "version" not in snapshot \
+                else int(snapshot["version"])
+        return snapshot
+    except MigrationError:
+        raise
+    except (TypeError, AttributeError, ValueError, KeyError) as e:
+        raise MigrationError(f"corrupt checkpoint: {e!r}") from e
 
 
 def migrate_state_dir(state_dir: str,
-                      keep_backup: bool = True) -> Tuple[int, int]:
+                      keep_backup: bool = True
+                      ) -> Tuple[int, int, List[str]]:
     """Upgrade every ``ep_*.json`` in place (the cilium-map-migrate
-    invocation from init.sh).  Returns (migrated, already_current).
-    Files that fail to parse/migrate are left untouched (and counted
-    in neither bucket) — a bad file must not block the rest."""
+    invocation from init.sh).  Returns (migrated, already_current,
+    skipped_names).  Files that fail to parse/migrate are left
+    untouched and REPORTED in skipped — a bad file must not block the
+    rest, but an operator running the tool after a downgrade must see
+    that nothing was migrated rather than a quiet success."""
     migrated = current = 0
+    skipped: List[str] = []
     if not os.path.isdir(state_dir):
-        return 0, 0
+        return 0, 0, []
     for fname in sorted(os.listdir(state_dir)):
         if not (fname.startswith("ep_") and fname.endswith(".json")):
             continue
@@ -132,6 +147,7 @@ def migrate_state_dir(state_dir: str,
                 json.dump(upgraded, f, indent=1, sort_keys=True)
             os.replace(tmp, path)
         except (OSError, ValueError, MigrationError):
+            skipped.append(fname)
             continue
         migrated += 1
-    return migrated, current
+    return migrated, current, skipped

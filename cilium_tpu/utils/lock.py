@@ -10,13 +10,15 @@ an acquire that exceeds ``DEADLOCK_TIMEOUT`` raises
 daemon forever.
 
 Like the reference, detection is opt-in (the "lockdebug" build tag
-analog): set the ``CILIUM_TPU_LOCKDEBUG`` env var, or
-``cilium_tpu.utils.lock.DEBUG = True``.  With it off (the default)
-these wrappers are thin pass-throughs — no stack capture on the hot
-path, no wait bound — exactly sync.Mutex.  With it on, any wait past
-``DEADLOCK_TIMEOUT`` raises instead of hanging; a legitimately long
-hold under debug is expected to trip it, which is the point of the
-debug build.
+analog) and decided at LOCK CONSTRUCTION time, exactly like a build
+tag: set the ``CILIUM_TPU_LOCKDEBUG`` env var before the process
+starts (or ``cilium_tpu.utils.lock.DEBUG = True`` before constructing
+the daemon).  With it off (the default) the Mutex/RMutex factories
+return raw C-level threading locks — zero overhead, no wait bound.
+With it on, any wait past ``DEADLOCK_TIMEOUT`` raises instead of
+hanging; a legitimately long hold under debug is expected to trip it,
+which is the point of the debug build.  Toggling DEBUG at runtime does
+not affect locks that already exist.
 """
 
 from __future__ import annotations

@@ -143,10 +143,35 @@ class Cache:
         return w
 
     def unwatch(self, watch: Watch) -> None:
+        """Drop a subscription.  A client that vanishes mid-barrier
+        (proxy crash during a policy push) must not strand the push:
+        its name is removed from every pending ACK set, and barriers
+        that only waited on it complete — the remaining watcher set is
+        what the push can still mean (the reference's e2e server
+        cancels the stream's pending completions the same way)."""
+        completed = []
         with self._lock:
             ws = self._watches.get(watch.type_url, [])
             if watch in ws:
                 ws.remove(watch)
+            # another live watch under the same client name (a restarted
+            # proxy resubscribing before the old conn reaps) still
+            # holds the barrier
+            live = {w.client for w in ws}
+            if watch.client not in live:
+                for (t, v), entries in list(self._pending.items()):
+                    if t != watch.type_url:
+                        continue
+                    for missing, comp in entries:
+                        missing.discard(watch.client)
+                        if not missing:
+                            completed.append(comp)
+                    self._pending[(t, v)] = [(m, c) for m, c in entries
+                                             if m]
+                    if not self._pending[(t, v)]:
+                        del self._pending[(t, v)]
+        for comp in completed:
+            comp.complete()
 
     # ---------------------------------------------------------------- ack
 

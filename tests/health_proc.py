@@ -1,0 +1,48 @@
+"""Subprocess agent for the cross-process health-probe test.
+
+Runs a Daemon connected to the shared TCP kvstore, registers its node,
+and serves a real HealthResponder socket — the cilium-health per-node
+endpoint.  Prints one JSON line with the responder port, then sleeps
+until killed (kill -9 models node death: probes start failing).
+
+Usage: python tests/health_proc.py <kv_port> <node_name>
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from cilium_tpu.daemon import Daemon  # noqa: E402
+from cilium_tpu.health import HealthResponder  # noqa: E402
+from cilium_tpu.kvstore.remote import RemoteBackend  # noqa: E402
+from cilium_tpu.node import Node, NodeAddress  # noqa: E402
+from cilium_tpu.utils.option import DaemonConfig  # noqa: E402
+
+
+def main() -> None:
+    kv_port = int(sys.argv[1])
+    node_name = sys.argv[2]
+    kv = RemoteBackend(port=kv_port, lease_ttl=10.0)
+    d = Daemon(config=DaemonConfig(), kvstore_backend=kv,
+               node_name=node_name)
+    responder = HealthResponder().start()
+    d.node_registry.register_local(Node(
+        name=node_name,
+        addresses=[NodeAddress("InternalIP", "127.0.0.1")],
+        ipv4_alloc_cidr="10.66.1.0/24"))
+    print(json.dumps({"health_port": responder.port,
+                      "pid": os.getpid()}), flush=True)
+    time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

@@ -85,14 +85,15 @@ def test_migrate_state_dir_in_place(tmp_path):
     with open(os.path.join(d, "ep_bad.json"), "w") as f:
         f.write("{not json")
 
-    migrated, current = migrate_state_dir(d)
+    migrated, current, skipped = migrate_state_dir(d)
     assert (migrated, current) == (2, 1)
+    assert skipped == ["ep_bad.json"]  # reported, not silently eaten
     for name in ("ep_7.json", "ep_8.json", "ep_9.json"):
         with open(os.path.join(d, name)) as f:
             assert json.load(f)["version"] == CHECKPOINT_VERSION
     assert os.path.exists(os.path.join(d, "ep_7.json.bak"))
     # idempotent second run
-    assert migrate_state_dir(d) == (0, 3)
+    assert migrate_state_dir(d) == (0, 3, ["ep_bad.json"])
 
 
 def test_daemon_restores_across_versions(tmp_path):
@@ -130,3 +131,23 @@ def test_cli_migrate_state(tmp_path, capsys):
     assert "migrated 1" in out
     with open(os.path.join(d, "ep_7.json")) as f:
         assert json.load(f)["version"] == CHECKPOINT_VERSION
+
+
+def test_corrupt_snapshots_raise_migration_error():
+    """Corrupt data surfaces as MigrationError (the skip-one-file
+    contract), never a stray TypeError that aborts the restore."""
+    for bad in ({"version": None, "id": 1},
+                {"version": 0, "id": 1, "realized": [1, 2]},
+                {"id": 1, "realized": {"1234:80:6:0": None}}):
+        with pytest.raises(MigrationError):
+            migrate_snapshot(dict(bad))
+
+
+def test_cli_migrate_state_reports_skipped(tmp_path, capsys):
+    from cilium_tpu.cli import main
+    d = str(tmp_path)
+    with open(os.path.join(d, "ep_99.json"), "w") as f:
+        json.dump({"version": 99, "id": 99}, f)
+    assert main(["migrate-state", d]) == 1  # nonzero: nothing migrated
+    err = capsys.readouterr().err
+    assert "SKIPPED" in err and "ep_99.json" in err

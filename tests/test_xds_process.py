@@ -237,3 +237,71 @@ def test_daemon_serves_xds_to_child_proxy():
     assert _wait(lambda: redir.id not in got)
     client.close()
     d.shutdown()
+
+
+# ------------------------------------------- hostile-client behavior
+# (pkg/envoy/xds/server_e2e_test.go: slow clients, NACKs, stream
+#  disconnects must not wedge the agent's push barriers)
+
+def test_slow_client_holds_barrier_until_it_acks():
+    """All-watchers semantics: one fast ACKer is not enough while a
+    slow client hasn't applied yet."""
+    cache = Cache()
+    server = XDSWireServer(cache).start()
+    fast = XDSWireClient(server.port, client="fast")
+    fast.subscribe(TYPE_NETWORK_POLICY, lambda v, res: True)
+    gate = threading.Event()
+    slow = XDSWireClient(server.port, client="slow")
+    slow.subscribe(TYPE_NETWORK_POLICY,
+                   lambda v, res: gate.wait(30) or True)
+
+    v = cache.set_resources(TYPE_NETWORK_POLICY, {"1": {}})
+    comp = cache.wait_for_acks(TYPE_NETWORK_POLICY, v)
+    assert not comp.wait(0.8), "barrier completed without the slow ACK"
+    gate.set()  # slow client finally applies
+    assert comp.wait(10)
+    fast.close()
+    slow.close()
+    server.shutdown()
+
+
+def test_nacking_client_does_not_block_other_subscribers():
+    cache = Cache()
+    server = XDSWireServer(cache).start()
+    good_versions = []
+    good = XDSWireClient(server.port, client="good")
+    good.subscribe(TYPE_NETWORK_POLICY,
+                   lambda v, res: (good_versions.append(v), True)[1])
+    bad = XDSWireClient(server.port, client="bad")
+    bad.subscribe(TYPE_NETWORK_POLICY, lambda v, res: False)  # NACKs
+
+    v = cache.set_resources(TYPE_NETWORK_POLICY, {"1": {}})
+    assert _wait(lambda: v in good_versions)
+    assert _wait(lambda: any(n[1] == "bad" and n[2] == v
+                             for n in cache.nacks))
+    # the good client keeps receiving subsequent versions
+    v2 = cache.set_resources(TYPE_NETWORK_POLICY, {"1": {}, "2": {}})
+    assert _wait(lambda: v2 in good_versions)
+    good.close()
+    bad.close()
+    server.shutdown()
+
+
+def test_client_disconnect_mid_barrier_unblocks_push():
+    """A proxy that dies while a push waits on its ACK must not wedge
+    the agent: the barrier completes on the surviving watcher set."""
+    cache = Cache()
+    server = XDSWireServer(cache).start()
+    fast = XDSWireClient(server.port, client="fast")
+    fast.subscribe(TYPE_NETWORK_POLICY, lambda v, res: True)
+    dead = XDSWireClient(server.port, client="doomed")
+    dead.subscribe(TYPE_NETWORK_POLICY,
+                   lambda v, res: time.sleep(60) or True)  # never acks
+
+    v = cache.set_resources(TYPE_NETWORK_POLICY, {"1": {}})
+    comp = cache.wait_for_acks(TYPE_NETWORK_POLICY, v)
+    assert not comp.wait(0.5)
+    dead.close()  # kill -9 analog: the connection drops mid-barrier
+    assert comp.wait(10), "barrier stranded on a dead client"
+    fast.close()
+    server.shutdown()
