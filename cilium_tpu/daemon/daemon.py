@@ -496,7 +496,17 @@ class Daemon:
     def service_upsert(self, vip: str, port: int,
                        backends: Sequence[Tuple[str, int]],
                        proto: int = 6) -> None:
-        """PUT /service (daemon/loadbalancer.go)."""
+        """PUT /service (daemon/loadbalancer.go) — family-routed: v6
+        VIPs program the lb6 tables (lb.h lb6_* family)."""
+        if ":" in vip:
+            from ..compiler.lpm import ipv6_to_words
+            from ..datapath.lb import Backend6, Service6
+            svc6 = Service6(vip=ipv6_to_words(vip), port=port,
+                            proto=proto,
+                            backends=[Backend6(ipv6_to_words(ip), p)
+                                      for ip, p in backends])
+            self.datapath.upsert_service6(svc6)
+            return
         svc = Service(vip=ipv4_to_u32(vip), port=port, proto=proto,
                       backends=[Backend(ipv4_to_u32(ip), p)
                                 for ip, p in backends])
@@ -504,6 +514,10 @@ class Daemon:
         self.datapath.reload_services()
 
     def service_delete(self, vip: str, port: int, proto: int = 6) -> bool:
+        if ":" in vip:
+            from ..compiler.lpm import ipv6_to_words
+            return self.datapath.delete_service6(ipv6_to_words(vip),
+                                                 port, proto)
         ok = self.datapath.lb.delete_service(ipv4_to_u32(vip), port, proto)
         if ok:
             self.datapath.reload_services()

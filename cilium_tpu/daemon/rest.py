@@ -222,6 +222,14 @@ def _u32_to_ipv4(v: int) -> str:
     return ".".join(str((v >> s) & 0xFF) for s in (24, 16, 8, 0))
 
 
+def _words_to_ipv6(words) -> str:
+    import ipaddress
+    v = 0
+    for w in words:
+        v = (v << 32) | (int(w) & 0xFFFFFFFF)
+    return str(ipaddress.IPv6Address(v))
+
+
 def _service_dump(d: Daemon):
     out = []
     for svc in d.datapath.lb.services():
@@ -229,6 +237,13 @@ def _service_dump(d: Daemon):
                     "proto": svc.proto,
                     "backends": [{"ip": _u32_to_ipv4(b.addr),
                                   "port": b.port} for b in svc.backends]})
+    # v6 services (lb6 registry) are part of the same audit surface
+    for svc6 in d.datapath.lb6_services.values():
+        out.append({"vip": _words_to_ipv6(svc6.vip), "port": svc6.port,
+                    "proto": svc6.proto,
+                    "backends": [{"ip": _words_to_ipv6(b.addr),
+                                  "port": b.port}
+                                 for b in svc6.backends]})
     return out
 
 

@@ -24,7 +24,8 @@ from ..compiler.lpm import (CompiledLPM, CompiledLPM6, compile_lpm,
 from ..compiler.policy_tables import CompiledPolicy, compile_endpoints
 from ..policy.mapstate import PolicyMapState
 from .conntrack import ConntrackTable, make_ct_state
-from .lb import CompiledLB, LoadBalancer, Service, compile_lb
+from .lb import (CompiledLB, CompiledLB6, LoadBalancer, Service,
+                 Service6, compile_lb, compile_lb6)
 from .pipeline import (DatapathTables, FullPacketBatch, FullPacketBatch6,
                        FullTables, FullTables6, build_tables,
                        full_datapath_step, full_datapath_step6,
@@ -56,6 +57,12 @@ class Datapath:
         self.compiled_policy: Optional[CompiledPolicy] = None
         self.compiled_ipcache: Optional[CompiledLPM] = None
         self.compiled_ipcache6: Optional[CompiledLPM6] = None
+        # v6 service registry (lb6): (vip words, port, proto) -> Service6
+        self.lb6_services: Dict[tuple, Service6] = {}
+        self.compiled_lb6: Optional[CompiledLB6] = None
+        # monotonic across deletes: freed rev-NAT indices stay retired
+        # (live CT entries may still carry them)
+        self._lb6_next_rev = 1
         # tunnel map (pkg/maps/tunnel): pod CIDR -> tunnel endpoint u32,
         # programmed by the NodeManager on node add/delete
         self.tunnel_prefixes: Dict[str, int] = {}
@@ -141,6 +148,37 @@ class Datapath:
         with self._lock:
             self.compiled_ipcache6 = compile_lpm6(prefixes6)
             self._rebuild()
+
+    def upsert_service6(self, svc: Service6) -> None:
+        """Program a v6 service (lb6 family).  rev_nat_index stability
+        matches the v4 LoadBalancer: replacing a service keeps its
+        index so live CT entries keep resolving the same VIP."""
+        key = (tuple(svc.vip), svc.port, svc.proto)
+        with self._lock:
+            old = self.lb6_services.get(key)
+            if svc.rev_nat_index <= 0:
+                if old is not None:
+                    svc.rev_nat_index = old.rev_nat_index
+                else:
+                    svc.rev_nat_index = self._lb6_next_rev
+            self._lb6_next_rev = max(self._lb6_next_rev,
+                                     svc.rev_nat_index + 1)
+            self.lb6_services[key] = svc
+            self.compiled_lb6 = compile_lb6(
+                list(self.lb6_services.values()))
+            self._rebuild()
+
+    def delete_service6(self, vip: tuple, port: int,
+                        proto: int = 6) -> bool:
+        with self._lock:
+            if self.lb6_services.pop((tuple(vip), port, proto),
+                                     None) is None:
+                return False
+            self.compiled_lb6 = compile_lb6(
+                list(self.lb6_services.values())) \
+                if self.lb6_services else None
+            self._rebuild()
+            return True
 
     def load_tunnel(self, prefixes: Dict[str, int]) -> None:
         """Program the tunnel map: pod CIDR -> tunnel endpoint node IP
@@ -250,15 +288,18 @@ class Datapath:
         pf6 = self.prefilter._compiled6
         if pf6 is None or pf6.entry_count() == 0:
             pf6 = compile_lpm6({})
+        lb6 = self.compiled_lb6
         self._tables6 = FullTables6(
             key_id=dp.key_id, key_meta=dp.key_meta, value=dp.value,
-            ipcache6=lpm6_tables(ipc6), pf6=lpm6_tables(pf6))
+            ipcache6=lpm6_tables(ipc6), pf6=lpm6_tables(pf6),
+            lb6=lb6.tables if lb6 is not None else None)
         self._step6 = jax.jit(functools.partial(
             full_datapath_step6,
             policy_probe=policy_probe,
             lpm6_probe=max(1, ipc6.max_probe),
             pf6_probe=max(1, pf6.max_probe),
-            ct_slots=self.ct6.slots, ct_probe=self.ct6.max_probe),
+            ct_slots=self.ct6.slots, ct_probe=self.ct6.max_probe,
+            lb6_probe=lb6.max_probe if lb6 is not None else 0),
             donate_argnums=(1, 2))
 
     # -- the hot path --------------------------------------------------------
@@ -278,15 +319,15 @@ class Datapath:
     def process6(self, pkt: FullPacketBatch6,
                  now: Optional[int] = None):
         """Classify a v6 batch (bpf_lxc.c:745 ipv6_policy path).
-        Returns (verdict, event, identity)."""
+        Returns (verdict, event, identity, nat6)."""
         with self._lock:
             if self._step6 is None:
                 raise RuntimeError("no policy loaded")
-            (verdict, event, identity,
+            (verdict, event, identity, nat,
              self.ct6.state, self.counters) = self._step6(
                 self._tables6, self.ct6.state, self.counters, pkt,
                 jnp.int32(now if now is not None else int(time.time())))
-            return verdict, event, identity
+            return verdict, event, identity, nat
 
     # -- maintenance ---------------------------------------------------------
 

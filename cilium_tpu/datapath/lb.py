@@ -211,3 +211,178 @@ class LoadBalancer:
 
     def rev_nat(self, saddr, sport, rev_nat_idx):
         return lb_rev_nat(self.compiled.tables, saddr, sport, rev_nat_idx)
+
+
+# ---------------------------------------------------------------------------
+# IPv6 service LB (bpf/lib/lb.h lb6_* family)
+# ---------------------------------------------------------------------------
+#
+# Same structure as the v4 tables with addresses as four int32 words
+# and full 128-bit exact compares on the service lookup; backends and
+# rev-NAT rows store complete v6 addresses, so DNAT and reply
+# translation are exact.
+
+@dataclass(frozen=True)
+class Backend6:
+    addr: Tuple[int, int, int, int]  # big-endian u32 words
+    port: int
+
+
+@dataclass
+class Service6:
+    vip: Tuple[int, int, int, int]
+    port: int
+    proto: int = 6
+    backends: List[Backend6] = field(default_factory=list)
+    rev_nat_index: int = 0
+
+
+class LB6Tables(NamedTuple):
+    svc_k0: jnp.ndarray      # [S] vip words
+    svc_k1: jnp.ndarray
+    svc_k2: jnp.ndarray
+    svc_k3: jnp.ndarray
+    svc_kb: jnp.ndarray      # [S] port<<16 | proto<<8 | 1 (0 = empty)
+    svc_value: jnp.ndarray   # [S] service index
+    svc_count: jnp.ndarray   # [NSVC]
+    svc_offset: jnp.ndarray
+    svc_revnat: jnp.ndarray
+    b_addr: jnp.ndarray      # [NB, 4]
+    b_port: jnp.ndarray      # [NB]
+    rev_vip: jnp.ndarray     # [NR, 4]
+    rev_port: jnp.ndarray    # [NR]
+
+
+@dataclass
+class CompiledLB6:
+    tables: LB6Tables
+    max_probe: int
+    num_services: int
+    num_backends: int
+
+
+def _hash6_words(w0, w1, w2, w3, kb):
+    from ..compiler.hashtab import hash_mix
+    return hash_mix(hash_mix(np.uint32(w0), np.uint32(w1)),
+                    hash_mix(np.uint32(w2) ^ np.uint32(kb),
+                             np.uint32(w3)))
+
+
+def compile_lb6(services: Sequence[Service6]) -> CompiledLB6:
+    """Lower v6 services; rev_nat_index stability contract identical
+    to compile_lb."""
+    used = {s.rev_nat_index for s in services if s.rev_nat_index > 0}
+    # monotonic allocation past the highest index ever seen — NOT
+    # lowest-free: a freed index may still be recorded in live CT
+    # entries (they deliberately survive recompiles), and reusing it
+    # would reverse-NAT an old flow's replies to a NEW service's VIP
+    next_free = max(used, default=0) + 1
+    for svc in services:
+        if svc.rev_nat_index <= 0:
+            svc.rev_nat_index = next_free
+            used.add(next_free)
+            next_free += 1
+    max_idx = max(used, default=0)
+    n = len(services)
+    slots = 8
+    while slots < 2 * max(n, 1):
+        slots *= 2
+    k = [np.zeros(slots, np.int32) for _ in range(4)]
+    kb = np.zeros(slots, np.int32)
+    value = np.zeros(slots, np.int32)
+    counts, offsets, revnats = [], [], []
+    b_addr: List[Tuple[int, int, int, int]] = []
+    b_port: List[int] = []
+    rev_vip = [(0, 0, 0, 0)] * (max_idx + 1)
+    rev_port = [0] * (max_idx + 1)
+    max_probe = 1
+    for i, svc in enumerate(services):
+        occ = ((svc.port & 0xFFFF) << 16) | ((svc.proto & 0xFF) << 8) | 1
+        h = int(_hash6_words(*svc.vip, occ)) & (slots - 1)
+        probe = 0
+        while kb[(h + probe) % slots] != 0:
+            probe += 1
+        s = (h + probe) % slots
+        for j in range(4):
+            k[j][s] = np.uint32(svc.vip[j]).view(np.int32)
+        # int32 bit-pattern: ports >= 0x8000 push occ past int32 max
+        kb[s] = np.uint32(occ).view(np.int32)
+        value[s] = i
+        max_probe = max(max_probe, probe + 1)
+        offsets.append(len(b_addr))
+        counts.append(len(svc.backends))
+        revnats.append(svc.rev_nat_index)
+        for b in svc.backends:
+            b_addr.append(b.addr)
+            b_port.append(b.port)
+        rev_vip[svc.rev_nat_index] = svc.vip
+        rev_port[svc.rev_nat_index] = svc.port
+    w = lambda rows: jnp.asarray(
+        np.asarray(rows or [(0, 0, 0, 0)], np.uint32).view(np.int32))
+    tables = LB6Tables(
+        svc_k0=jnp.asarray(k[0]), svc_k1=jnp.asarray(k[1]),
+        svc_k2=jnp.asarray(k[2]), svc_k3=jnp.asarray(k[3]),
+        svc_kb=jnp.asarray(kb), svc_value=jnp.asarray(value),
+        svc_count=jnp.asarray(np.asarray(counts or [0], np.int32)),
+        svc_offset=jnp.asarray(np.asarray(offsets or [0], np.int32)),
+        svc_revnat=jnp.asarray(np.asarray(revnats or [0], np.int32)),
+        b_addr=w(b_addr),
+        b_port=jnp.asarray(np.asarray(b_port or [0], np.int32)),
+        rev_vip=w(rev_vip), rev_port=jnp.asarray(
+            np.asarray(rev_port, np.int32)))
+    return CompiledLB6(tables=tables, max_probe=max_probe,
+                       num_services=n, num_backends=len(b_addr))
+
+
+def _hash6_jnp_words(w0, w1, w2, w3, kb):
+    return hash_mix_jnp(hash_mix_jnp(w0, w1),
+                        hash_mix_jnp(w2 ^ kb, w3))
+
+
+def lb6_step(tables: LB6Tables, daddr, dport, proto, saddr, sport,
+             *, max_probe: int):
+    """v6 service DNAT (lb6_lookup_service + lb6_select_slave +
+    lb6_local).  daddr/saddr are [B, 4].
+
+    Returns (new_daddr [B, 4], new_dport, rev_nat_idx, is_service)."""
+    slots = tables.svc_kb.shape[0]
+    mask = jnp.int32(slots - 1)
+    qb = ((dport & 0xFFFF) << 16) | ((proto & 0xFF) << 8) | 1
+    h = _hash6_jnp_words(daddr[:, 0], daddr[:, 1], daddr[:, 2],
+                         daddr[:, 3], qb)
+    probes = (h[:, None] & mask) + \
+        jnp.arange(max_probe, dtype=jnp.int32)[None, :]
+    probes = probes & mask                                     # [B, K]
+    hit = (tables.svc_k0[probes] == daddr[:, 0:1]) & \
+        (tables.svc_k1[probes] == daddr[:, 1:2]) & \
+        (tables.svc_k2[probes] == daddr[:, 2:3]) & \
+        (tables.svc_k3[probes] == daddr[:, 3:4]) & \
+        (tables.svc_kb[probes] == qb[:, None]) & \
+        (tables.svc_kb[probes] != 0)
+    found = jnp.any(hit, axis=1)
+    svc_idx = jnp.sum(jnp.where(hit, tables.svc_value[probes],
+                                jnp.int32(0)), axis=1)
+    count = tables.svc_count[svc_idx]
+    offset = tables.svc_offset[svc_idx]
+    from ..datapath.pipeline import fold6
+    hsel = hash_mix_jnp(hash_mix_jnp(fold6(saddr), fold6(daddr)),
+                        hash_mix_jnp(((sport & 0xFFFF) << 16) |
+                                     (dport & 0xFFFF), proto))
+    slave = jnp.where(count > 0,
+                      jnp.abs(hsel) % jnp.maximum(count, 1),
+                      jnp.int32(0))
+    bidx = offset + slave
+    ok = found & (count > 0)
+    new_daddr = jnp.where(ok[:, None], tables.b_addr[bidx], daddr)
+    new_dport = jnp.where(ok, tables.b_port[bidx], dport)
+    rev_nat = jnp.where(ok, tables.svc_revnat[svc_idx], jnp.int32(0))
+    return new_daddr, new_dport, rev_nat, ok
+
+
+def lb6_rev_nat(tables: LB6Tables, saddr, sport, rev_nat_idx):
+    """Reply-path v6 reverse NAT (lb6_rev_nat): saddr [B, 4]."""
+    has = rev_nat_idx > 0
+    nmax = tables.rev_vip.shape[0]
+    idx = jnp.clip(jnp.where(has, rev_nat_idx, 0), 0, nmax - 1)
+    return (jnp.where(has[:, None], tables.rev_vip[idx], saddr),
+            jnp.where(has, tables.rev_port[idx], sport))

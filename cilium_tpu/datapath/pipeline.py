@@ -374,12 +374,24 @@ class LPM6Tables(NamedTuple):
     plens: jnp.ndarray   # [P]
 
 
+class NAT6Result(NamedTuple):
+    """v6 forwarding result: DNAT'd destination (forward) and
+    rev-NAT'd VIP-restored source (reply).  Addresses [B, 4]."""
+
+    daddr: jnp.ndarray
+    dport: jnp.ndarray
+    saddr: jnp.ndarray
+    sport: jnp.ndarray
+    rev_nat: jnp.ndarray
+
+
 class FullTables6(NamedTuple):
     key_id: jnp.ndarray      # shared policy tables [E, S]
     key_meta: jnp.ndarray
     value: jnp.ndarray
     ipcache6: LPM6Tables
     pf6: LPM6Tables
+    lb6: object = None       # LB6Tables (None = no v6 services)
 
 
 def lpm6_tables(c) -> LPM6Tables:
@@ -401,18 +413,21 @@ def fold6(words: jnp.ndarray) -> jnp.ndarray:
 def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                         pkt: FullPacketBatch6, now: jnp.ndarray, *,
                         policy_probe: int, lpm6_probe: int,
-                        pf6_probe: int, ct_slots: int, ct_probe: int):
+                        pf6_probe: int, ct_slots: int, ct_probe: int,
+                        lb6_probe: int = 0):
     """The v6 twin of full_datapath_step (bpf_lxc.c:745 ipv6_policy):
-    prefilter drop, conntrack, ipcache identity, policy verdict for
-    CT_NEW flows, CT create gated on the verdict.  (v6 service LB —
-    the reference's lb6 — is not yet wired; daddr passes through.)
+    prefilter drop, service DNAT (lb6_local), conntrack, ipcache
+    identity, policy verdict for CT_NEW flows, CT create gated on the
+    verdict, reply-path reverse NAT (lb6_rev_nat).
 
-    Returns (verdict [B], event [B], identity [B], ct', counters').
+    Returns (verdict [B], event [B], identity [B], nat6, ct',
+    counters').
     """
     from ..ops.lpm_ops import lpm6_lookup
     from .conntrack import CT_NEW, CTBatch, ct_step
     from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_PREFILTER,
                          TRACE_TO_LXC, TRACE_TO_PROXY)
+    from .lb import lb6_rev_nat, lb6_step
     from .verdict import VERDICT_DROP, VERDICT_DROP_FRAG, verdict_step
 
     b = pkt.sport.shape[0]
@@ -427,14 +442,23 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
     else:
         pf_hit = jnp.zeros(b, bool)
 
-    # 2. Conntrack on folded addresses (separate v6 table).
-    ctb = CTBatch(saddr=fold6(pkt.saddr), daddr=fold6(pkt.daddr),
-                  sport=pkt.sport, dport=pkt.dport, proto=pkt.proto,
+    # 2. Service LB DNAT (lb.h lb6_local).
+    if lb6_probe > 0 and tables.lb6 is not None:
+        daddr, dport, rev_nat, _is_svc = lb6_step(
+            tables.lb6, pkt.daddr, pkt.dport, pkt.proto, pkt.saddr,
+            pkt.sport, max_probe=lb6_probe)
+    else:
+        daddr, dport = pkt.daddr, pkt.dport
+        rev_nat = jnp.zeros(b, jnp.int32)
+
+    # 3. Conntrack on the DNAT'd folded tuple (separate v6 table).
+    ctb = CTBatch(saddr=fold6(pkt.saddr), daddr=fold6(daddr),
+                  sport=pkt.sport, dport=dport, proto=pkt.proto,
                   direction=pkt.direction, tcp_flags=pkt.tcp_flags,
                   related=jnp.zeros_like(pkt.proto))
 
-    # 3. ipcache6: identity of the peer (src on ingress, dst on egress).
-    peer = jnp.where((pkt.direction == 0)[:, None], pkt.saddr, pkt.daddr)
+    # 4. ipcache6: identity of the peer (src on ingress, dst on egress).
+    peer = jnp.where((pkt.direction == 0)[:, None], pkt.saddr, daddr)
     if tables.ipcache6.kb.shape[0] > 0:
         found, ident = lpm6_lookup(
             tables.ipcache6.masks, tables.ipcache6.k0,
@@ -453,27 +477,40 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
         identity = jnp.where(pkt.mark_identity > 0,
                              pkt.mark_identity, identity)
 
-    # 4. Policy verdict on the shared (family-agnostic) tables.
+    # 5. Policy verdict on the shared (family-agnostic) tables —
+    # against the DNAT'd port, like the v4 path.
     vb = PacketBatch(endpoint=pkt.endpoint, identity=identity,
-                     dport=pkt.dport, proto=pkt.proto,
+                     dport=dport, proto=pkt.proto,
                      direction=pkt.direction, length=pkt.length,
                      is_fragment=pkt.is_fragment)
     pol_verdict, counters = verdict_step(tables.key_id, tables.key_meta,
                                          tables.value, counters, vb,
                                          policy_probe)
 
-    # 5. CT step, creation gated on the verdict.
+    # 6. CT step, creation gated on the verdict; new entries record the
+    # flow's rev-NAT index so replies can restore the VIP.
     create_ok = (pol_verdict >= 0) & ~pf_hit
     proxy_in = jnp.maximum(pol_verdict, 0)
-    ct_verdict, _ct_rev_nat, ct_proxy, ct = ct_step(
+    ct_verdict, ct_rev_nat, ct_proxy, ct = ct_step(
         ct, ctb, now, create_ok, update_mask=~pf_hit,
-        rev_nat_in=jnp.zeros_like(pol_verdict), proxy_port_in=proxy_in,
+        rev_nat_in=rev_nat, proxy_port_in=proxy_in,
         slots=ct_slots, max_probe=ct_probe)
 
     established = ct_verdict != CT_NEW
     verdict = jnp.where(
         pf_hit, jnp.int32(VERDICT_DROP),
         jnp.where(established, ct_proxy, pol_verdict))
+
+    # 7. Reply-path reverse NAT (lb6_rev_nat).
+    from .conntrack import CT_RELATED, CT_REPLY
+    is_reply = (ct_verdict == CT_REPLY) | (ct_verdict == CT_RELATED)
+    rn = jnp.where(is_reply, ct_rev_nat, jnp.int32(0))
+    if tables.lb6 is not None:
+        nat_saddr, nat_sport = lb6_rev_nat(tables.lb6, pkt.saddr,
+                                           pkt.sport, rn)
+    else:
+        nat_saddr, nat_sport = pkt.saddr, pkt.sport
+
     event = jnp.where(
         pf_hit, jnp.int32(DROP_PREFILTER),
         jnp.where(verdict == VERDICT_DROP_FRAG,
@@ -482,4 +519,6 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                             jnp.where(verdict > 0,
                                       jnp.int32(TRACE_TO_PROXY),
                                       jnp.int32(TRACE_TO_LXC)))))
-    return verdict, event, identity, ct, counters
+    nat = NAT6Result(daddr=daddr, dport=dport, saddr=nat_saddr,
+                     sport=nat_sport, rev_nat=ct_rev_nat)
+    return verdict, event, identity, nat, ct, counters

@@ -131,7 +131,7 @@ def test_v6_verdicts_against_oracle():
         daddr=["2001:db8:aa::1"] * 4,
         sport=[10001, 10002, 10003, 10004],
         dport=[443, 444, 443, 80], direction=[0, 0, 0, 0])
-    verdict, event, identity = dp.process6(batch, now=50)
+    verdict, event, identity, _n = dp.process6(batch, now=50)
     verdict, event, identity = (np.asarray(verdict), np.asarray(event),
                                 np.asarray(identity))
     assert identity.tolist() == [700, 700, 2, 701]
@@ -149,7 +149,7 @@ def test_v6_cidr_egress_verdict():
         saddr=["2001:db8:aa::1"] * 2,
         daddr=["2001:db8:1:2::9", "2001:db9::9"],
         sport=[20001, 20002], dport=[8080, 8080], direction=[1, 1])
-    verdict, _e, identity = dp.process6(batch, now=50)
+    verdict, _e, identity, _n = dp.process6(batch, now=50)
     assert np.asarray(identity).tolist() == [9, 2]
     assert np.asarray(verdict)[0] == 0
     assert np.asarray(verdict)[1] < 0
@@ -164,7 +164,7 @@ def test_v6_prefilter_drop_beats_policy():
         endpoint=[0], saddr=["2001:db8:7::5"],
         daddr=["2001:db8:aa::1"], sport=[30001], dport=[443],
         direction=[0])
-    verdict, event, _i = dp.process6(batch, now=50)
+    verdict, event, _i, _n = dp.process6(batch, now=50)
     assert np.asarray(verdict)[0] < 0
     assert np.asarray(event)[0] == DROP_PREFILTER
 
@@ -178,10 +178,10 @@ def test_v6_conntrack_continuation_keeps_proxy_port():
         endpoint=[0], saddr=["2001:db8:8::5"],
         daddr=["2001:db8:aa::1"], sport=[sport], dport=[80],
         direction=[0])
-    v1, _e, _i = dp.process6(mk(40001), now=50)
+    v1, _e, _i, _n = dp.process6(mk(40001), now=50)
     assert np.asarray(v1)[0] == 14001
     # same flow again: established, same proxy port from the CT entry
-    v2, _e, _i = dp.process6(mk(40001), now=60)
+    v2, _e, _i, _n = dp.process6(mk(40001), now=60)
     assert np.asarray(v2)[0] == 14001
     # v4 CT table is untouched by v6 flows
     assert int(np.asarray(dp.ct.state.k3).astype(bool).sum()) == 0
@@ -196,7 +196,7 @@ def test_v6_overlay_decap_identity():
         endpoint=[0], saddr=["9999::1"], daddr=["2001:db8:aa::1"],
         sport=[50001], dport=[443], direction=[0],
         from_overlay=[1], tunnel_id=[700])
-    verdict, _e, identity = dp.process6(batch, now=50)
+    verdict, _e, identity, _n = dp.process6(batch, now=50)
     # 9999::1 is unknown to the ipcache (would be WORLD) — the tunnel
     # identity decides
     assert np.asarray(identity)[0] == 700
@@ -243,9 +243,171 @@ def test_daemon_v6_cidr_rule_to_verdict():
         daddr=["2001:db8:55::9", "2001:db8:55::9", "2001:db8:66::9"],
         sport=[61001, 61002, 61003], dport=[443, 80, 443],
         direction=[1, 1, 1])
-    verdict, _e, identity = d.datapath.process6(batch, now=100)
+    verdict, _e, identity, _n = d.datapath.process6(batch, now=100)
     verdict = np.asarray(verdict)
     assert verdict[0] == 0, (verdict, np.asarray(identity))
     assert verdict[1] < 0  # wrong port
     assert verdict[2] < 0  # outside the CIDR
     d.shutdown()
+
+
+# --------------------------------------------------------- v6 service LB
+
+def test_v6_service_lb_dnat_and_rev_nat():
+    """lb6 family: VIP -> backend DNAT on the forward path, VIP
+    restoration on the reply path (lb.h lb6_local + lb6_rev_nat)."""
+    from cilium_tpu.compiler.lpm import ipv6_to_words
+    from cilium_tpu.datapath.lb import Backend6, Service6
+
+    st = PolicyMapState()
+    # egress allow to the backends' identity on the backend port
+    st[PolicyKey(identity=9, dest_port=8443, nexthdr=6,
+                 direction=EGRESS)] = PolicyMapStateEntry()
+    dp = Datapath(ct_slots=1 << 8, ct_probe=4)
+    dp.load_policy([st], revision=1, ipcache_prefixes={})
+    dp.load_ipcache6({"2001:db8:1::/48": 9})
+    vip = "2001:db8:f::10"
+    dp.upsert_service6(Service6(
+        vip=ipv6_to_words(vip), port=443,
+        backends=[Backend6(ipv6_to_words("2001:db8:1::a"), 8443),
+                  Backend6(ipv6_to_words("2001:db8:1::b"), 8443)]))
+
+    batch = make_full_batch6(
+        endpoint=[0, 0], saddr=["2001:db8:aa::1"] * 2,
+        daddr=[vip, "2001:db8:1::a"],
+        sport=[50001, 50002], dport=[443, 8443], direction=[1, 1])
+    verdict, _e, _i, nat = dp.process6(batch, now=50)
+    verdict = np.asarray(verdict)
+    # packet 0: VIP hit -> DNAT to one of the backends on 8443, and
+    # the policy verdict ran against the DNAT'd port (allowed)
+    assert verdict[0] == 0
+    got = np.asarray(nat.daddr)[0].astype(np.uint32).tolist()
+    backends = [list(ipv6_to_words("2001:db8:1::a")),
+                list(ipv6_to_words("2001:db8:1::b"))]
+    assert got in backends
+    assert np.asarray(nat.dport)[0] == 8443
+    # packet 1: direct-to-backend, untouched
+    assert np.asarray(nat.daddr)[1].astype(np.uint32).tolist() == \
+        backends[0]
+
+    # reply path: the backend answers; the reply's source is restored
+    # to the VIP via the CT-carried rev-NAT index (proof the index was
+    # recorded at create)
+    chosen = got
+    reply = make_full_batch6(
+        endpoint=[0], saddr=["::1"],  # placeholder, replaced below
+        daddr=["2001:db8:aa::1"], sport=[8443], dport=[50001],
+        direction=[0])
+    reply = reply._replace(saddr=jnp.asarray(
+        np.asarray([chosen], np.uint32).view(np.int32)))
+    v2, _e2, _i2, nat2 = dp.process6(reply, now=55)
+    restored = np.asarray(nat2.saddr)[0].astype(np.uint32).tolist()
+    assert restored == list(ipv6_to_words(vip))
+    assert np.asarray(nat2.sport)[0] == 443
+
+
+def test_daemon_v6_service_upsert_routes_by_family():
+    import json
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.policy.jsonio import rules_from_json
+    from cilium_tpu.utils.option import DaemonConfig
+    d = Daemon(config=DaemonConfig())
+    try:
+        ep = d.endpoint_create(1, ipv4="10.44.0.3",
+                               labels=["k8s:app=v6lb"])
+        rev = d.policy_add(rules_from_json(json.dumps([{
+            "endpointSelector": {"matchLabels": {"app": "v6lb"}},
+            "egress": [{"toCIDR": ["2001:db8:66::/48"]}]}])))
+        d.wait_for_policy_revision(rev)
+        d.service_upsert("2001:db8:ff::1", 80,
+                         [("2001:db8:66::5", 8080)])
+        batch = make_full_batch6(
+            endpoint=[ep.table_slot], saddr=["2001:db8:aa::1"],
+            daddr=["2001:db8:ff::1"], sport=[51001], dport=[80],
+            direction=[1])
+        verdict, _e, _i, nat = dp_out = d.datapath.process6(batch,
+                                                            now=60)
+        from cilium_tpu.compiler.lpm import ipv6_to_words
+        assert np.asarray(nat.daddr)[0].astype(np.uint32).tolist() == \
+            list(ipv6_to_words("2001:db8:66::5"))
+        assert np.asarray(nat.dport)[0] == 8080
+        # DNAT'd destination is inside the allowed v6 CIDR -> allowed
+        assert np.asarray(verdict)[0] == 0
+        assert d.service_delete("2001:db8:ff::1", 80)
+        v2, _e2, _i2, nat2 = d.datapath.process6(
+            batch._replace(sport=jnp.asarray(
+                np.asarray([51002], np.int32))), now=61)
+        assert np.asarray(nat2.rev_nat)[0] == 0  # no more DNAT
+    finally:
+        d.shutdown()
+
+
+def test_lb6_high_port_and_rev_nat_index_stability():
+    """Review regressions: NodePort-range ports compile (int32 bit
+    pattern), and a deleted service's rev-NAT index is never reused
+    (live CT entries may still carry it)."""
+    from cilium_tpu.compiler.lpm import ipv6_to_words
+    from cilium_tpu.datapath.lb import Backend6, Service6, compile_lb6
+
+    # port >= 32768 must not overflow
+    c = compile_lb6([Service6(vip=ipv6_to_words("2001:db8::1"),
+                              port=40000,
+                              backends=[Backend6(
+                                  ipv6_to_words("2001:db8::2"), 8080)])])
+    assert c.num_services == 1
+
+    dp = Datapath(ct_slots=1 << 8, ct_probe=4)
+    st = PolicyMapState()
+    st[PolicyKey(identity=9, dest_port=8443, nexthdr=6,
+                 direction=EGRESS)] = PolicyMapStateEntry()
+    dp.load_policy([st], revision=1, ipcache_prefixes={})
+    mk = lambda ip: Service6(vip=ipv6_to_words(ip), port=443,
+                             backends=[Backend6(
+                                 ipv6_to_words("2001:db8::b"), 8443)])
+    a = mk("2001:db8:a::1")
+    dp.upsert_service6(a)
+    idx_a = a.rev_nat_index
+    assert idx_a > 0
+    dp.delete_service6(ipv6_to_words("2001:db8:a::1"), 443)
+    b = mk("2001:db8:b::1")
+    dp.upsert_service6(b)
+    assert b.rev_nat_index != idx_a  # retired index never reused
+    # high-port service through the engine path too
+    dp.upsert_service6(Service6(vip=ipv6_to_words("2001:db8:c::1"),
+                                port=30080,
+                                backends=[Backend6(
+                                    ipv6_to_words("2001:db8::c"),
+                                    8443)]))
+    batch = make_full_batch6(
+        endpoint=[0], saddr=["2001:db8:aa::1"],
+        daddr=["2001:db8:c::1"], sport=[52001], dport=[30080],
+        direction=[1])
+    _v, _e, _i, nat = dp.process6(batch, now=70)
+    from cilium_tpu.compiler.lpm import ipv6_to_words as w6
+    assert np.asarray(nat.daddr)[0].astype(np.uint32).tolist() == \
+        list(w6("2001:db8::c"))
+    assert np.asarray(nat.dport)[0] == 8443
+
+
+def test_rest_service_dump_includes_v6():
+    import json as _json
+    import urllib.request
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.utils.option import DaemonConfig
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    try:
+        d.service_upsert("10.96.0.50", 80, [("10.0.0.5", 8080)])
+        d.service_upsert("2001:db8:ff::2", 443,
+                         [("2001:db8:66::7", 8443)])
+        with urllib.request.urlopen(srv.base_url + "/service") as r:
+            svcs = _json.loads(r.read())
+        vips = {s["vip"] for s in svcs}
+        assert "10.96.0.50" in vips
+        assert "2001:db8:ff::2" in vips
+        v6 = [s for s in svcs if s["vip"] == "2001:db8:ff::2"][0]
+        assert v6["backends"] == [{"ip": "2001:db8:66::7",
+                                   "port": 8443}]
+    finally:
+        d.shutdown()

@@ -65,7 +65,7 @@ def test_mark_identity_v6():
         daddr=["2001:db8::2"] * 2, sport=[41003, 41004],
         dport=[9000, 9000], direction=[0, 0],
         mark_identity=[777, 0])
-    verdict, _e, identity = dp.process6(batch, now=50)
+    verdict, _e, identity, _n = dp.process6(batch, now=50)
     assert np.asarray(identity).tolist() == [777, 2]
     assert np.asarray(verdict)[0] == 0
     assert np.asarray(verdict)[1] < 0
