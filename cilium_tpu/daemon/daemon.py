@@ -605,7 +605,20 @@ class Daemon:
             "controllers": self.controllers.status_model(),
             "datapath": {"revision": self.datapath.revision,
                          "conntrack-slots": self.datapath.ct.slots},
+            # runtime capability probes (bpf/run_probes.sh analog)
+            "features": self._features(),
         }
+
+    def _features(self) -> Dict:
+        if not hasattr(self, "_features_cache"):
+            from ..utils.platform import probe_features
+            # health-path contract: never trigger a fresh backend init
+            # (a wedged relay would hang /healthz forever) and reuse
+            # the native probe done at __init__ instead of compiling
+            self._features_cache = probe_features(
+                allow_init=False,
+                native_fastpath=self.host_path is not None)
+        return self._features_cache
 
     def _endpoint_state_counts(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}

@@ -113,3 +113,68 @@ def main_with_fallback(run, timeout: float | None = None,
     print(json.dumps({"metric": fail_metric, "value": 0, "unit": fail_unit,
                       "vs_baseline": 0.0,
                       "extra": {"error": last_err[-600:]}}))
+
+
+def _jax_backend_initialized() -> bool:
+    """True iff a jax backend already exists in this process (so
+    reading it cannot trigger a fresh — potentially hanging — init)."""
+    try:
+        import jax
+        from jax._src import xla_bridge
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:  # noqa: BLE001 — conservatively "not ready"
+        return False
+
+
+def probe_features(allow_init: bool = True,
+                   native_fastpath: "bool | None" = None):
+    """Runtime capability probing (bpf/run_probes.sh + bpf_features.h
+    analog): what does THIS process's accelerator stack support?  The
+    reference probes the kernel before committing the datapath to map
+    types; here the probes gate engine/kernels choices and surface in
+    `cilium status` so an operator can see what the node runs on.
+
+    ``allow_init=False`` is the health-path contract: never trigger a
+    fresh backend init (the relay can wedge forever inside native code
+    — see module docstring) — if no backend exists yet, the jax block
+    is reported deferred.  ``native_fastpath`` lets a caller that has
+    already probed the native build (the daemon) pass the answer in,
+    so the status path never runs a synchronous g++ compile.
+    """
+    feats = {}
+    if allow_init or _jax_backend_initialized():
+        try:
+            import jax
+            backend = jax.default_backend()
+            devices = jax.devices()
+            feats["backend"] = backend
+            feats["device_count"] = len(devices)
+            feats["device_kind"] = (
+                getattr(devices[0], "device_kind", str(devices[0]))
+                if devices else "none")
+            feats["platform_version"] = getattr(jax, "__version__", "")
+            feats["on_accelerator"] = backend != "cpu"
+        except Exception as e:  # noqa: BLE001 — report, never raise
+            feats["backend"] = f"unavailable: {e!r}"
+            feats["on_accelerator"] = False
+    else:
+        feats["backend"] = "deferred: backend not initialized"
+        feats["on_accelerator"] = False
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        feats["pallas"] = True
+    except Exception:  # noqa: BLE001
+        feats["pallas"] = False
+    if native_fastpath is None:
+        try:
+            from ..native import load as _native_load
+            _native_load()
+            native_fastpath = True
+        except Exception:  # noqa: BLE001
+            native_fastpath = False
+    feats["native_fastpath"] = bool(native_fastpath)
+    feats["verdict_engines"] = ["hash", "dense"] + \
+        (["dense-pallas"] if feats.get("pallas") and
+         feats.get("on_accelerator") else []) + ["bucket2choice"] + \
+        (["host-cache"] if feats.get("native_fastpath") else [])
+    return feats

@@ -304,3 +304,29 @@ def test_metrics_kind_collision_raises():
     reg.counter("hits")
     with pytest.raises(ValueError):
         reg.gauge("hits")
+
+
+def test_probe_features():
+    """Runtime capability probing (bpf/run_probes.sh analog)."""
+    from cilium_tpu.utils.platform import probe_features
+    f = probe_features()
+    assert f["backend"] == "cpu"          # conftest pins CPU
+    assert f["on_accelerator"] is False
+    assert f["device_count"] == 8          # virtual mesh
+    assert isinstance(f["pallas"], bool)
+    assert "hash" in f["verdict_engines"]
+    assert "bucket2choice" in f["verdict_engines"]
+    if f["native_fastpath"]:
+        assert "host-cache" in f["verdict_engines"]
+
+
+def test_status_reports_features():
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.utils.option import DaemonConfig
+    d = Daemon(config=DaemonConfig())
+    try:
+        st = d.status()
+        assert st["features"]["backend"] == "cpu"
+        assert "verdict_engines" in st["features"]
+    finally:
+        d.shutdown()
