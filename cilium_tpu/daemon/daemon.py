@@ -610,15 +610,24 @@ class Daemon:
         }
 
     def _features(self) -> Dict:
-        if not hasattr(self, "_features_cache"):
+        cached = getattr(self, "_features_cache", None)
+        if cached is None:
             from ..utils.platform import probe_features
             # health-path contract: never trigger a fresh backend init
             # (a wedged relay would hang /healthz forever) and reuse
             # the native probe done at __init__ instead of compiling
-            self._features_cache = probe_features(
+            probed = probe_features(
                 allow_init=False,
                 native_fastpath=self.host_path is not None)
-        return self._features_cache
+            # only cache a definitive probe: a deferred/unavailable
+            # result must re-probe next time, or status would report
+            # no accelerator forever after the backend comes up
+            backend = str(probed.get("backend", ""))
+            if not (backend.startswith("deferred") or
+                    backend.startswith("unavailable")):
+                self._features_cache = probed
+            return probed
+        return cached
 
     def _endpoint_state_counts(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}

@@ -1,0 +1,60 @@
+"""Incremental internet checksum updates for NAT rewrites.
+
+Reference: bpf/lib/csum.h — after the datapath rewrites addresses or
+ports (LB DNAT, rev-NAT, NAT46), the L3/L4 checksums are fixed
+incrementally (csum_l4_replace over csum_diff) rather than recomputed
+over the payload.  Same here, batched: given the old and new values of
+the rewritten fields, produce the updated checksum per packet
+(RFC 1624 eqn. 3: HC' = ~(~HC + ~m + m')).
+
+All values are uint16/uint32 carried in int32 lanes, like the rest of
+the datapath.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _ones_fold(x: jnp.ndarray) -> jnp.ndarray:
+    """Fold a 32-bit sum to 16 bits (ones-complement carry wrap)."""
+    x = (x & 0xFFFF) + ((x >> 16) & 0xFFFF)
+    x = (x & 0xFFFF) + ((x >> 16) & 0xFFFF)
+    return x & 0xFFFF
+
+
+def csum_update_u16(csum: jnp.ndarray, old: jnp.ndarray,
+                    new: jnp.ndarray) -> jnp.ndarray:
+    """RFC 1624 incremental update for one 16-bit field.
+
+    csum/old/new: [B] int32 holding u16 values; returns [B] u16."""
+    c = (~csum) & 0xFFFF
+    c = c + ((~old) & 0xFFFF) + (new & 0xFFFF)
+    return (~_ones_fold(c)) & 0xFFFF
+
+
+def csum_update_u32(csum: jnp.ndarray, old: jnp.ndarray,
+                    new: jnp.ndarray) -> jnp.ndarray:
+    """Incremental update for a 32-bit field (an address): applied as
+    its two 16-bit halves (csum_diff over 4 bytes)."""
+    c = csum_update_u16(csum, (old >> 16) & 0xFFFF, (new >> 16) & 0xFFFF)
+    return csum_update_u16(c, old & 0xFFFF, new & 0xFFFF)
+
+
+def checksum16(words: jnp.ndarray) -> jnp.ndarray:
+    """Full ones-complement checksum over [B, N] u16 words — the
+    from-scratch reference the incremental path is tested against.
+    int32-safe for N < 2^15 words (far beyond any header)."""
+    s = jnp.sum(words.astype(jnp.int32) & 0xFFFF, axis=1)
+    s = (s & 0xFFFF) + (s >> 16)
+    s = (s & 0xFFFF) + (s >> 16)
+    return (~s) & 0xFFFF
+
+
+def nat_csum_fix(l4_csum: jnp.ndarray, old_addr: jnp.ndarray,
+                 new_addr: jnp.ndarray, old_port: jnp.ndarray,
+                 new_port: jnp.ndarray) -> jnp.ndarray:
+    """The DNAT fix-up (lb4 path): TCP/UDP checksums cover the
+    pseudo-header, so an address+port rewrite updates both."""
+    c = csum_update_u32(l4_csum, old_addr, new_addr)
+    return csum_update_u16(c, old_port, new_port)

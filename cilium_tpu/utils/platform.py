@@ -115,15 +115,20 @@ def main_with_fallback(run, timeout: float | None = None,
                       "extra": {"error": last_err[-600:]}}))
 
 
-def _jax_backend_initialized() -> bool:
-    """True iff a jax backend already exists in this process (so
-    reading it cannot trigger a fresh — potentially hanging — init)."""
+def _jax_backend_initialized():
+    """True/False iff a jax backend does/doesn't already exist in this
+    process (so reading it cannot trigger a fresh — potentially
+    hanging — init); None when the detector itself is unavailable
+    (jax moved the internal attribute) — callers surface that
+    distinctly rather than silently reporting 'not initialized'."""
     try:
-        import jax
+        import jax  # noqa: F401
         from jax._src import xla_bridge
-        return bool(getattr(xla_bridge, "_backends", None))
-    except Exception:  # noqa: BLE001 — conservatively "not ready"
+    except Exception:  # noqa: BLE001
         return False
+    if not hasattr(xla_bridge, "_backends"):
+        return None  # detector broken: make it visible, don't guess
+    return bool(xla_bridge._backends)
 
 
 def probe_features(allow_init: bool = True,
@@ -142,7 +147,12 @@ def probe_features(allow_init: bool = True,
     so the status path never runs a synchronous g++ compile.
     """
     feats = {}
-    if allow_init or _jax_backend_initialized():
+    initialized = _jax_backend_initialized()
+    if initialized is None and not allow_init:
+        feats["backend"] = ("deferred: init-state detector unavailable "
+                            "(jax internals changed)")
+        feats["on_accelerator"] = False
+    elif allow_init or initialized:
         try:
             import jax
             backend = jax.default_backend()
@@ -161,8 +171,11 @@ def probe_features(allow_init: bool = True,
         feats["backend"] = "deferred: backend not initialized"
         feats["on_accelerator"] = False
     try:
-        import jax.experimental.pallas  # noqa: F401
-        feats["pallas"] = True
+        # the same flag the dense engine gates its kernel on — one
+        # definition, so the advertised engine list can't diverge from
+        # what dense_verdict_pallas will actually accept
+        from ..ops.dense_verdict import HAS_PALLAS
+        feats["pallas"] = bool(HAS_PALLAS)
     except Exception:  # noqa: BLE001
         feats["pallas"] = False
     if native_fastpath is None:
