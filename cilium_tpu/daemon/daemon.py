@@ -622,9 +622,7 @@ class Daemon:
             # only cache a definitive probe: a deferred/unavailable
             # result must re-probe next time, or status would report
             # no accelerator forever after the backend comes up
-            backend = str(probed.get("backend", ""))
-            if not (backend.startswith("deferred") or
-                    backend.startswith("unavailable")):
+            if probed.get("definitive", False):
                 self._features_cache = probed
             return probed
         return cached

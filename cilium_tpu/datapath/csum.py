@@ -53,8 +53,17 @@ def checksum16(words: jnp.ndarray) -> jnp.ndarray:
 
 def nat_csum_fix(l4_csum: jnp.ndarray, old_addr: jnp.ndarray,
                  new_addr: jnp.ndarray, old_port: jnp.ndarray,
-                 new_port: jnp.ndarray) -> jnp.ndarray:
+                 new_port: jnp.ndarray,
+                 udp: bool = False) -> jnp.ndarray:
     """The DNAT fix-up (lb4 path): TCP/UDP checksums cover the
-    pseudo-header, so an address+port rewrite updates both."""
+    pseudo-header, so an address+port rewrite updates both.
+
+    ``udp=True`` applies the mangled-zero rule
+    (BPF_F_MARK_MANGLED_0 in csum_l4_replace): a computed UDP checksum
+    of 0x0000 is transmitted as 0xFFFF — zero means "no checksum" on
+    the wire for v4 and is forbidden outright for v6."""
     c = csum_update_u32(l4_csum, old_addr, new_addr)
-    return csum_update_u16(c, old_port, new_port)
+    c = csum_update_u16(c, old_port, new_port)
+    if udp:
+        c = jnp.where(c == 0, jnp.int32(0xFFFF), c)
+    return c

@@ -146,12 +146,13 @@ def probe_features(allow_init: bool = True,
     already probed the native build (the daemon) pass the answer in,
     so the status path never runs a synchronous g++ compile.
     """
-    feats = {}
+    feats = {"definitive": True}
     initialized = _jax_backend_initialized()
     if initialized is None and not allow_init:
         feats["backend"] = ("deferred: init-state detector unavailable "
                             "(jax internals changed)")
         feats["on_accelerator"] = False
+        feats["definitive"] = False
     elif allow_init or initialized:
         try:
             import jax
@@ -167,9 +168,11 @@ def probe_features(allow_init: bool = True,
         except Exception as e:  # noqa: BLE001 — report, never raise
             feats["backend"] = f"unavailable: {e!r}"
             feats["on_accelerator"] = False
+            feats["definitive"] = False
     else:
         feats["backend"] = "deferred: backend not initialized"
         feats["on_accelerator"] = False
+        feats["definitive"] = False
     try:
         # the same flag the dense engine gates its kernel on — one
         # definition, so the advertised engine list can't diverge from

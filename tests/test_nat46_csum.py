@@ -91,3 +91,18 @@ def test_incremental_u32_and_nat_fix():
         words[0], words[1] = (new_addr >> 16) & 0xFFFF, new_addr & 0xFFFF
         words[2] = new_port
         assert fixed == _scratch_csum(words)
+
+
+def test_udp_mangled_zero():
+    """BPF_F_MARK_MANGLED_0: a computed UDP checksum of 0 is sent as
+    0xFFFF (zero means 'no checksum' on the wire / forbidden for v6)."""
+    arr = lambda v: jnp.asarray(np.asarray([v], np.uint32)
+                                .view(np.int32))
+    # identity rewrite of a packet whose checksum is 0: the fold keeps
+    # it 0, and the udp flag mangles it to 0xFFFF
+    out = nat_csum_fix(arr(0), arr(0), arr(0), arr(0), arr(0),
+                       udp=True)
+    assert int(np.asarray(out)[0]) == 0xFFFF
+    # TCP (default) leaves 0 alone
+    out = nat_csum_fix(arr(0), arr(0), arr(0), arr(0), arr(0))
+    assert int(np.asarray(out)[0]) == 0
