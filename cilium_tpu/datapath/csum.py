@@ -58,12 +58,15 @@ def nat_csum_fix(l4_csum: jnp.ndarray, old_addr: jnp.ndarray,
     """The DNAT fix-up (lb4 path): TCP/UDP checksums cover the
     pseudo-header, so an address+port rewrite updates both.
 
-    ``udp=True`` applies the mangled-zero rule
-    (BPF_F_MARK_MANGLED_0 in csum_l4_replace): a computed UDP checksum
-    of 0x0000 is transmitted as 0xFFFF — zero means "no checksum" on
-    the wire for v4 and is forbidden outright for v6."""
+    ``udp=True`` applies the full BPF_F_MARK_MANGLED_0 rule
+    (bpf_l4_csum_replace): an INCOMING checksum of 0x0000 means "no
+    checksum computed" for v4 UDP and is left untouched (updating it
+    would fabricate a bogus checksum the receiver then validates), and
+    a COMPUTED result of 0x0000 is transmitted as 0xFFFF (zero is the
+    no-checksum marker / forbidden for v6)."""
     c = csum_update_u32(l4_csum, old_addr, new_addr)
     c = csum_update_u16(c, old_port, new_port)
     if udp:
         c = jnp.where(c == 0, jnp.int32(0xFFFF), c)
+        c = jnp.where(l4_csum == 0, jnp.int32(0), c)
     return c

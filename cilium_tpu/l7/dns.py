@@ -20,7 +20,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..compiler.regexc import compile_regex_set
-from ..ops.dfa_ops import dfa_match, encode_strings
+from ..ops.dfa_ops import (bucket_rows, device_dfa_tables,
+                           dfa_match, encode_strings)
 from ..policy.api import CIDRRule, FQDNSelector, Rule
 
 DNS_POLLER_INTERVAL = 5.0  # reference: dnspoller.go:50 (5s)
@@ -82,17 +83,18 @@ class DNSPolicyEngine:
         self._compiled = compile_regex_set(
             [s.to_regex() for s in self.selectors]) if self.selectors \
             else None
+        if self._compiled is not None:
+            self._c_table, self._c_accept, self._c_starts = \
+                device_dfa_tables(self._compiled)
 
     def match(self, names: Sequence[str]) -> np.ndarray:
         """[B, R] selector hits for a batch of names."""
         if self._compiled is None:
             return np.zeros((len(names), 0), bool)
-        data = jnp.asarray(encode_strings([_canon(n) for n in names],
-                                          MAX_NAME_LEN))
-        return np.asarray(dfa_match(jnp.asarray(self._compiled.table),
-                                    jnp.asarray(self._compiled.accept),
-                                    jnp.asarray(self._compiled.starts),
-                                    data))
+        data = jnp.asarray(bucket_rows(encode_strings(
+            [_canon(n) for n in names], MAX_NAME_LEN)))
+        return np.asarray(dfa_match(self._c_table, self._c_accept,
+                                    self._c_starts, data))[:len(names)]
 
     def allowed(self, names: Sequence[str]) -> np.ndarray:
         hits = self.match(names)

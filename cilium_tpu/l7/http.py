@@ -23,7 +23,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..compiler.regexc import CompiledRegexSet, compile_regex_set
-from ..ops.dfa_ops import dfa_match, encode_strings
+from ..ops.dfa_ops import (bucket_rows, device_dfa_tables,
+                           dfa_match, encode_strings)
 from ..policy.api import PortRuleHTTP
 
 MAX_REQUEST_LINE = 512
@@ -69,6 +70,10 @@ class HTTPPolicyEngine:
             return
         self._combined = compile_regex_set(
             [_rule_to_combined_regex(r) for r in self.rules])
+        # device-resident once: re-uploading per check() costs more
+        # than the match at small batches
+        self._c_table, self._c_accept, self._c_starts = \
+            device_dfa_tables(self._combined)
         header_patterns: List[str] = []
         self._header_slices: List[Tuple[int, int]] = []
         for r in self.rules:
@@ -77,6 +82,9 @@ class HTTPPolicyEngine:
             self._header_slices.append((start, len(header_patterns)))
         self._headers = compile_regex_set(header_patterns) \
             if header_patterns else None
+        if self._headers is not None:
+            self._h_table, self._h_accept, self._h_starts = \
+                device_dfa_tables(self._headers)
 
     def check(self, requests: Sequence[HTTPRequest]) -> np.ndarray:
         """Batched verdicts: [B] bool (True == allow)."""
@@ -84,11 +92,12 @@ class HTTPPolicyEngine:
             return np.ones(len(requests), bool)
         lines = [f"{r.method}\x00{r.path}\x00{(r.host or '').lower()}"
                  for r in requests]
-        data = jnp.asarray(encode_strings(lines, MAX_REQUEST_LINE))
+        b = len(lines)
+        data = jnp.asarray(bucket_rows(
+            encode_strings(lines, MAX_REQUEST_LINE)))
         rule_hit = np.array(dfa_match(
-            jnp.asarray(self._combined.table),
-            jnp.asarray(self._combined.accept),
-            jnp.asarray(self._combined.starts), data))      # [B, R]
+            self._c_table, self._c_accept, self._c_starts,
+            data))[:b]                                      # [B, R]
 
         if self._headers is not None:
             blocks = []
@@ -97,11 +106,11 @@ class HTTPPolicyEngine:
                 canon = "\x01".join(f"{k.lower()}: {v}"
                                     for k, v in sorted(hdrs.items()))
                 blocks.append("\x01" + canon + "\x01")
-            hdata = jnp.asarray(encode_strings(blocks, MAX_HEADER_BLOCK))
+            hdata = jnp.asarray(bucket_rows(
+                encode_strings(blocks, MAX_HEADER_BLOCK)))
             hdr_hit = np.asarray(dfa_match(
-                jnp.asarray(self._headers.table),
-                jnp.asarray(self._headers.accept),
-                jnp.asarray(self._headers.starts), hdata))  # [B, H]
+                self._h_table, self._h_accept, self._h_starts,
+                hdata))[:b]                                 # [B, H]
             for ri, (s, e) in enumerate(self._header_slices):
                 if e > s:
                     rule_hit[:, ri] &= hdr_hit[:, s:e].all(axis=1)

@@ -94,15 +94,22 @@ def test_incremental_u32_and_nat_fix():
 
 
 def test_udp_mangled_zero():
-    """BPF_F_MARK_MANGLED_0: a computed UDP checksum of 0 is sent as
-    0xFFFF (zero means 'no checksum' on the wire / forbidden for v6)."""
+    """Full BPF_F_MARK_MANGLED_0 semantics: an incoming v4 UDP
+    checksum of 0 means 'not computed' and is left at 0 across NAT;
+    a nonzero checksum whose updated value folds to 0 is sent as
+    0xFFFF; TCP is untouched by either rule."""
     arr = lambda v: jnp.asarray(np.asarray([v], np.uint32)
                                 .view(np.int32))
-    # identity rewrite of a packet whose checksum is 0: the fold keeps
-    # it 0, and the udp flag mangles it to 0xFFFF
-    out = nat_csum_fix(arr(0), arr(0), arr(0), arr(0), arr(0),
+    # incoming 0 stays 0 even across a real rewrite
+    out = nat_csum_fix(arr(0), arr(0x0A000001), arr(0x0A000002),
+                       arr(80), arr(8080), udp=True)
+    assert int(np.asarray(out)[0]) == 0
+    # a nonzero checksum that folds to zero after the update is
+    # mangled to 0xFFFF: identity rewrite of csum 0xFFFF keeps the
+    # fold at ~(~0xFFFF + 0) = 0 -> mangled
+    out = nat_csum_fix(arr(0xFFFF), arr(5), arr(5), arr(7), arr(7),
                        udp=True)
     assert int(np.asarray(out)[0]) == 0xFFFF
-    # TCP (default) leaves 0 alone
+    # TCP (default): incremental math only, no mangling
     out = nat_csum_fix(arr(0), arr(0), arr(0), arr(0), arr(0))
     assert int(np.asarray(out)[0]) == 0
