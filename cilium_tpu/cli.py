@@ -30,14 +30,15 @@ class Client:
         self.base_url = base_url.rstrip("/")
 
     def request(self, method: str, path: str, body=None,
-                raw: bool = False, raw_body: Optional[bytes] = None):
+                raw: bool = False, raw_body: Optional[bytes] = None,
+                timeout: float = 30):
         data = raw_body if raw_body is not None else \
             (None if body is None else json.dumps(body).encode())
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
             headers={"Content-Type": "application/json"} if data else {})
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 payload = resp.read()
         except urllib.error.HTTPError as e:
             payload = e.read()
@@ -129,6 +130,63 @@ def cmd_policy(c: Client, args) -> int:
         print(out["trace"])
         print(f"Final verdict: {out['verdict'].upper()}")
         return 0 if out["verdict"] == "allowed" else 1
+    elif args.policy_cmd == "validate":
+        # cilium policy validate: parse + sanitize locally, no import
+        from .policy.jsonio import rules_from_json
+        text = sys.stdin.read() if args.file == "-" else \
+            open(args.file).read()
+        rules = rules_from_json(text)
+        for r in rules:
+            r.sanitize()
+        print(f"Valid: {len(rules)} rule(s)")
+    elif args.policy_cmd == "wait":
+        # cilium policy wait: block until every endpoint realized the
+        # revision (policy_wait.go)
+        # the transport deadline must outlive the server-side wait
+        out = c.request("POST", "/policy/wait",
+                        {"revision": args.revision,
+                         "timeout": args.timeout},
+                        timeout=args.timeout + 10)
+        state = "realized" if out["realized"] else "TIMED OUT"
+        print(f"Revision {out['revision']}: {state}")
+        return 0 if out["realized"] else 1
+    return 0
+
+
+def cmd_node(c: Client, args) -> int:
+    nodes = c.get("/node")
+    if args.json:
+        _print_json(nodes)
+        return 0
+    for n in nodes:
+        addrs = ",".join(a.get("IP", "") for a in
+                         (n.get("IPAddresses") or []))
+        print(f"{n.get('Name','?'):30s} {addrs:20s} "
+              f"{n.get('IPv4AllocCIDR') or '-'}")
+    return 0
+
+
+def cmd_map(c: Client, args) -> int:
+    """cilium map list / cilium bpf <map> list analogs: device-table
+    inventory and entry dumps."""
+    if args.map_cmd == "list":
+        _print_json(c.get("/map"))
+    elif args.map_cmd == "get":
+        _print_json(c.get(f"/map/{args.name}?n={args.n}"))
+    return 0
+
+
+def cmd_version(c: Client, args) -> int:
+    from . import __version__ as v
+    print(f"Client: cilium-tpu {v}")
+    try:
+        st = c.get("/healthz")
+        feats = st.get("features", {})
+        print(f"Daemon: cilium-tpu {st.get('version', 'unknown')} "
+              f"(backend {feats.get('backend', '?')}, "
+              f"uptime {st.get('uptime-seconds', 0):.0f}s)")
+    except Exception as e:  # noqa: BLE001 — client-only mode
+        print(f"Daemon: unreachable ({e})")
     return 0
 
 
@@ -358,6 +416,29 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--dst", nargs="+", required=True)
     tr.add_argument("--dport", nargs="*")
     tr.add_argument("-v", "--verbose", action="store_true")
+    val = pol_sub.add_parser("validate",
+                             help="parse + sanitize locally, no import")
+    val.add_argument("file", help="rules JSON file, or - for stdin")
+    pw = pol_sub.add_parser("wait",
+                            help="block until a revision is realized")
+    pw.add_argument("--revision", type=int, default=None)
+    pw.add_argument("--timeout", type=float, default=30.0)
+
+    nd = sub.add_parser("node", help="cluster node list")
+    nd.add_argument("--json", action="store_true")
+
+    mp = sub.add_parser("map",
+                        help="device table inventory + entry dumps "
+                             "(bpf map list analogs)")
+    mp_sub = mp.add_subparsers(dest="map_cmd", required=True)
+    mp_sub.add_parser("list")
+    mg = mp_sub.add_parser("get")
+    mg.add_argument("name",
+                    help="ipcache|ipcache6|ct|ct6|tunnel|lb|lb6|"
+                         "prefilter")
+    mg.add_argument("-n", type=int, default=4096)
+
+    sub.add_parser("version", help="client + daemon version")
 
     ep = sub.add_parser("endpoint", help="endpoint management")
     ep_sub = ep.add_subparsers(dest="endpoint_cmd", required=True)
@@ -445,6 +526,7 @@ COMMANDS = {
     "config": cmd_config, "metrics": cmd_metrics,
     "bugtool": cmd_bugtool, "cni": cmd_cni,
     "migrate-state": cmd_migrate_state,
+    "node": cmd_node, "map": cmd_map, "version": cmd_version,
 }
 
 

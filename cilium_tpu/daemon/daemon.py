@@ -587,7 +587,9 @@ class Daemon:
     def status(self) -> Dict:
         """GET /healthz (daemon/status.go status collector)."""
         kv = "ok" if self.kv is None else self.kv.status()
+        from .. import __version__
         return {
+            "version": __version__,
             "uptime-seconds": round(time.time() - self.started_at, 3),
             "kvstore": {"state": kv,
                         "backend": "none" if self.kv is None else

@@ -196,6 +196,32 @@ class _Handler(BaseHTTPRequestHandler):
                     for e in events])
             if path == "/monitor/stats" and method == "GET":
                 return self._send(200, d.monitor.stats())
+            if path == "/node" and method == "GET":
+                # cilium node list (pkg/node)
+                return self._send(200, [
+                    n.to_model() for n in
+                    (d.node_registry.nodes() if d.node_registry
+                     else d.node_manager.nodes())])
+            if path == "/map" and method == "GET":
+                # cilium map list / bpf map show analog
+                return self._send(200, d.datapath.map_inventory())
+            if path.startswith("/map/") and method == "GET":
+                # cilium bpf {ipcache,ct,tunnel,lb,prefilter} list
+                name = path[len("/map/"):]
+                limit = int(qs.get("n", ["4096"])[0])
+                try:
+                    return self._send(
+                        200, d.datapath.map_dump(name,
+                                                 max_entries=limit))
+                except KeyError:
+                    return self._error(404, f"unknown map {name!r}")
+            if path == "/policy/wait" and method == "POST":
+                body = json.loads(self._body() or b"{}")
+                rev = body.get("revision")
+                ok = d.wait_for_policy_revision(
+                    rev, timeout=float(body.get("timeout", 30)))
+                return self._send(200, {
+                    "realized": ok, "revision": d.repo.revision})
             return self._error(404, f"no route for {method} {path}")
         except PolicyError as exc:
             return self._error(400, str(exc))

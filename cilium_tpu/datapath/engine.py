@@ -57,6 +57,10 @@ class Datapath:
         self.compiled_policy: Optional[CompiledPolicy] = None
         self.compiled_ipcache: Optional[CompiledLPM] = None
         self.compiled_ipcache6: Optional[CompiledLPM6] = None
+        # host mirrors of what's compiled into the device LPMs (for
+        # the map-dump surface; the reference reads pinned maps back)
+        self.ipcache_prefixes: Dict[str, int] = {}
+        self.ipcache_prefixes6: Dict[str, int] = {}
         # v6 service registry (lb6): (vip words, port, proto) -> Service6
         self.lb6_services: Dict[tuple, Service6] = {}
         self.compiled_lb6: Optional[CompiledLB6] = None
@@ -139,13 +143,16 @@ class Datapath:
     def load_ipcache(self, prefixes: Dict[str, int],
                      prefixes6: Optional[Dict[str, int]] = None) -> None:
         with self._lock:
+            self.ipcache_prefixes = dict(prefixes)
             self.compiled_ipcache = compile_lpm(prefixes)
             if prefixes6 is not None:
+                self.ipcache_prefixes6 = dict(prefixes6)
                 self.compiled_ipcache6 = compile_lpm6(prefixes6)
             self._rebuild()
 
     def load_ipcache6(self, prefixes6: Dict[str, int]) -> None:
         with self._lock:
+            self.ipcache_prefixes6 = dict(prefixes6)
             self.compiled_ipcache6 = compile_lpm6(prefixes6)
             self._rebuild()
 
@@ -328,6 +335,100 @@ class Datapath:
                 self._tables6, self.ct6.state, self.counters, pkt,
                 jnp.int32(now if now is not None else int(time.time())))
             return verdict, event, identity, nat
+
+    # -- map dump surface (cilium bpf */list analogs) -----------------------
+
+    def map_inventory(self) -> Dict[str, Dict]:
+        """Per-map geometry + occupancy (cilium map list / bpf map
+        show): what state is device-resident right now."""
+        with self._lock:
+            out: Dict[str, Dict] = {}
+            if self._table_mgr is not None:
+                geom, _t = self._table_mgr.snapshot()
+                cap, slots, probe, gen = geom
+                out["policy"] = {"endpoints": cap, "slots": slots,
+                                 "max-probe": probe, "generation": gen}
+            elif self.compiled_policy is not None:
+                out["policy"] = {
+                    "endpoints": self.compiled_policy.num_endpoints,
+                    "slots": self.compiled_policy.slots,
+                    "max-probe": self.compiled_policy.max_probe,
+                    "entries": self.compiled_policy.entry_count()}
+            out["ipcache"] = {"entries": len(self.ipcache_prefixes)}
+            out["ipcache6"] = {"entries": len(self.ipcache_prefixes6)}
+            for name, tbl in (("ct", self.ct), ("ct6", self.ct6)):
+                out[name] = {"slots": tbl.slots,
+                             "occupied": tbl.entry_count(),
+                             "max-probe": tbl.max_probe}
+            out["lb"] = {"services": len(self.lb)}
+            out["lb6"] = {"services": len(self.lb6_services)}
+            out["tunnel"] = {"entries": len(self.tunnel_prefixes)}
+            pf = self.prefilter._compiled
+            pf6 = self.prefilter._compiled6
+            out["prefilter"] = {
+                "v4-entries": pf.entry_count() if pf else 0,
+                "v6-entries": pf6.entry_count() if pf6 else 0}
+            return out
+
+    def map_dump(self, name: str, max_entries: int = 4096):
+        """Entries of one device map (cilium bpf ipcache/ct/tunnel/lb
+        list).  CT dumps decode the LIVE device arrays — the exact
+        state the verdict path consults."""
+        # snapshot references under the lock, decode AFTER releasing
+        # it: the jax arrays are immutable, and holding the datapath
+        # lock through device->host transfers plus a Python decode
+        # loop would stall every concurrent process() call
+        with self._lock:
+            if name == "ipcache":
+                return dict(sorted(self.ipcache_prefixes.items())
+                            [:max_entries])
+            if name == "ipcache6":
+                return dict(sorted(self.ipcache_prefixes6.items())
+                            [:max_entries])
+            if name == "tunnel":
+                return {cidr: int(np.uint32(ip & 0xFFFFFFFF))
+                        for cidr, ip in
+                        sorted(self.tunnel_prefixes.items())
+                        [:max_entries]}
+            if name in ("ct", "ct6"):
+                st = (self.ct if name == "ct" else self.ct6).state
+            elif name == "lb":
+                svcs = self.lb.services()[:max_entries]
+            elif name == "lb6":
+                svcs6 = list(self.lb6_services.values())[:max_entries]
+            elif name == "prefilter":
+                cidrs, rev = self.prefilter.dump()
+                return {"cidrs": cidrs[:max_entries], "revision": rev}
+            else:
+                raise KeyError(name)
+        if name in ("ct", "ct6"):
+            k3 = np.asarray(st.k3)
+            # exclude the sentinel slot (the last row absorbs no-op
+            # scatters; entry_count has the same exclusion)
+            idx = np.flatnonzero(k3[:-1])[:max_entries]
+            k0 = np.asarray(st.k0).astype(np.uint32)
+            k1 = np.asarray(st.k1).astype(np.uint32)
+            k2 = np.asarray(st.k2).astype(np.uint32)
+            exp = np.asarray(st.expires)
+            rn = np.asarray(st.rev_nat)
+            pp = np.asarray(st.proxy_port)
+            return [{
+                "saddr": int(k0[i]), "daddr": int(k1[i]),
+                "sport": int(k2[i] >> 16),
+                "dport": int(k2[i] & 0xFFFF),
+                "proto": int((k3[i] >> 8) & 0xFF),
+                "ingress": not bool((k3[i] >> 1) & 1),
+                "expires": int(exp[i]),
+                "rev-nat": int(rn[i]),
+                "proxy-port": int(pp[i])} for i in idx.tolist()]
+        if name == "lb":
+            return [{"vip": int(np.uint32(s.vip & 0xFFFFFFFF)),
+                     "port": s.port, "proto": s.proto,
+                     "backends": len(s.backends),
+                     "rev-nat": s.rev_nat_index} for s in svcs]
+        return [{"vip": list(s.vip), "port": s.port,
+                 "proto": s.proto, "backends": len(s.backends),
+                 "rev-nat": s.rev_nat_index} for s in svcs6]
 
     # -- maintenance ---------------------------------------------------------
 

@@ -95,6 +95,13 @@ class NodeManager:
         self.tunnel_map.pop(cidr, None)
         self.routes.pop(cidr, None)
 
+    def nodes(self) -> list:
+        """Known peer nodes (manager view, for `cilium node list` when
+        no kvstore registry is attached)."""
+        with self._mu:
+            return sorted(self._nodes.values(),
+                          key=lambda n: n.full_name)
+
     def tunnel_endpoint_for(self, pod_cidr: str) -> Optional[str]:
         with self._mu:
             return self.tunnel_map.get(pod_cidr)

@@ -500,3 +500,87 @@ def test_incremental_row_sync_no_full_swap(agent):
     c.delete("/endpoint/4")
     assert d.table_mgr.generation == gen0
     assert d.table_mgr.slot_of(4) is None
+
+
+def test_map_inventory_and_dumps():
+    """cilium map list + bpf */list analogs: the device-table
+    inventory and entry dumps reflect live datapath state."""
+    import json as _json
+    import urllib.request
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.datapath.engine import make_full_batch
+    from cilium_tpu.policy.jsonio import rules_from_json
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    try:
+        ep = d.endpoint_create(1, ipv4="10.88.0.2",
+                               labels=["k8s:app=mapdump"])
+        rev = d.policy_add(rules_from_json(_json.dumps([{
+            "endpointSelector": {"matchLabels": {"app": "mapdump"}},
+            "ingress": [{"fromCIDR": ["10.88.1.0/24"]}]}])))
+        d.wait_for_policy_revision(rev)
+        # drive one allowed flow so the CT dump has an entry
+        batch = make_full_batch(endpoint=[ep.table_slot],
+                                saddr=["10.88.1.7"],
+                                daddr=["10.88.0.2"], sport=[47001],
+                                dport=[80], direction=[0])
+        verdict, _e, _i, _n = d.datapath.process(batch, now=100)
+        assert int(np.asarray(verdict)[0]) == 0
+
+        get = lambda p: _json.loads(urllib.request.urlopen(
+            srv.base_url + p).read())
+        inv = get("/map")
+        assert inv["ct"]["occupied"] >= 1
+        assert inv["ipcache"]["entries"] >= 2  # endpoint ip + CIDR
+        assert "policy" in inv and inv["policy"]["endpoints"] >= 1
+        ipc = get("/map/ipcache")
+        assert "10.88.1.0/24" in ipc
+        ct = get("/map/ct")
+        flows = [e for e in ct if e["dport"] == 80 and e["sport"] == 47001]
+        assert flows and flows[0]["ingress"] is True
+        # unknown map 404s
+        import urllib.error
+        try:
+            get("/map/nonsense")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # policy wait through REST
+        req = urllib.request.Request(
+            srv.base_url + "/policy/wait", method="POST",
+            data=_json.dumps({"revision": rev}).encode())
+        out = _json.loads(urllib.request.urlopen(req).read())
+        assert out["realized"] is True
+    finally:
+        d.shutdown()
+
+
+def test_cli_node_map_version_policy_wait(capsys):
+    import json as _json
+    from cilium_tpu.cli import main
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.node import Node, NodeAddress
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    try:
+        d.node_manager.node_updated(Node(
+            name="peer-1",
+            addresses=[NodeAddress("InternalIP", "192.168.9.9")],
+            ipv4_alloc_cidr="10.89.0.0/24"))
+        assert main(["--api", srv.base_url, "node"]) == 0
+        out = capsys.readouterr().out
+        assert "peer-1" in out and "10.89.0.0/24" in out
+        assert main(["--api", srv.base_url, "map", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "tunnel" in out and "conntrack" not in out
+        assert main(["--api", srv.base_url, "map", "get",
+                     "tunnel"]) == 0
+        out = capsys.readouterr().out
+        assert "10.89.0.0/24" in out
+        assert main(["--api", srv.base_url, "version"]) == 0
+        out = capsys.readouterr().out
+        assert "Client: cilium-tpu" in out and "Daemon:" in out
+        assert main(["--api", srv.base_url, "policy", "wait",
+                     "--timeout", "5"]) == 0
+    finally:
+        d.shutdown()
