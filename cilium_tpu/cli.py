@@ -220,6 +220,21 @@ def cmd_endpoint(c: Client, args) -> int:
     elif args.endpoint_cmd == "labels":
         out = c.patch(f"/endpoint/{args.id}", {"labels": args.labels})
         print("Labels updated" if out.get("ok") else "No change")
+    elif args.endpoint_cmd == "log":
+        # cilium endpoint log: the state-transition ring
+        for e in c.get(f"/endpoint/{args.id}/log"):
+            ts = time.strftime("%H:%M:%S",
+                               time.localtime(e["timestamp"]))
+            msg = f" ({e['message']})" if e.get("message") else ""
+            print(f"{ts}  {e['state']}{msg}")
+    elif args.endpoint_cmd == "regenerate":
+        out = c.post(f"/endpoint/{args.id}/regenerate")
+        print("Regeneration queued" if out.get("queued")
+              else "Already queued")
+    elif args.endpoint_cmd == "healthz":
+        out = c.get(f"/endpoint/{args.id}/healthz")
+        _print_json(out)
+        return 0 if out.get("healthy") else 1
     return 0
 
 
@@ -443,7 +458,7 @@ def build_parser() -> argparse.ArgumentParser:
     ep = sub.add_parser("endpoint", help="endpoint management")
     ep_sub = ep.add_subparsers(dest="endpoint_cmd", required=True)
     ep_sub.add_parser("list")
-    for name in ("get", "delete"):
+    for name in ("get", "delete", "log", "regenerate", "healthz"):
         e = ep_sub.add_parser(name)
         e.add_argument("id", type=int)
     e = ep_sub.add_parser("config")

@@ -133,6 +133,47 @@ class _Handler(BaseHTTPRequestHandler):
                         return self._send(200, {"ok": True,
                                                 "changed": changed})
                     return self._error(400, "nothing to patch")
+            m = re.fullmatch(r"/endpoint/(\d+)/log", path)
+            if m and method == "GET":
+                # cilium endpoint log (endpoint_log.go / the status
+                # ring of pkg/endpoint endpoint.go:1183)
+                ep = d.endpoints.lookup(int(m.group(1)))
+                if ep is None:
+                    return self._error(404, "endpoint not found")
+                return self._send(200, [
+                    {"timestamp": ts, "state": st, "message": reason}
+                    for ts, st, reason in ep.status_log])
+            m = re.fullmatch(r"/endpoint/(\d+)/regenerate", path)
+            if m and method == "POST":
+                # cilium endpoint regenerate (endpoint_regenerate.go).
+                # WAITING_TO_REGENERATE first, like every other trigger
+                # path — without it a not-ready endpoint's build is
+                # silently skipped by the state machine (the operator's
+                # recovery command must actually recover)
+                ep_id = int(m.group(1))
+                ep = d.endpoints.lookup(ep_id)
+                if ep is None:
+                    return self._error(404, "endpoint not found")
+                from ..endpoint import EndpointState as _ES
+                ep.set_state(_ES.WAITING_TO_REGENERATE,
+                             "api regenerate")
+                queued = d.endpoints.queue_regeneration(ep_id)
+                return self._send(200, {"queued": queued})
+            m = re.fullmatch(r"/endpoint/(\d+)/healthz", path)
+            if m and method == "GET":
+                # cilium endpoint healthz (endpoint_healthz.go)
+                ep = d.endpoints.lookup(int(m.group(1)))
+                if ep is None:
+                    return self._error(404, "endpoint not found")
+                return self._send(200, {
+                    "state": ep.state,
+                    "policy-revision": ep.policy_revision,
+                    "identity": ep.security_identity,
+                    # waiting-to-regenerate is a routine queued-rebuild
+                    # window (every policy import passes through it) —
+                    # healthy, like the strictly later regenerating
+                    "healthy": ep.state in ("ready", "regenerating",
+                                            "waiting-to-regenerate")})
             m = re.fullmatch(r"/endpoint/(\d+)/config", path)
             if m and method == "PATCH":
                 changes = json.loads(self._body() or b"{}")

@@ -584,3 +584,70 @@ def test_cli_node_map_version_policy_wait(capsys):
                      "--timeout", "5"]) == 0
     finally:
         d.shutdown()
+
+
+def test_endpoint_log_regenerate_healthz(capsys):
+    """cilium endpoint log / regenerate / healthz analogs
+    (endpoint_log.go, endpoint_regenerate.go, endpoint_healthz.go)."""
+    from cilium_tpu.cli import main
+    from cilium_tpu.daemon.rest import APIServer
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    try:
+        ep = d.endpoint_create(3, ipv4="10.90.0.3",
+                               labels=["k8s:app=logged"])
+        d.wait_for_policy_revision()
+        assert main(["--api", srv.base_url, "endpoint", "log",
+                     "3"]) == 0
+        out = capsys.readouterr().out
+        # the status ring shows the lifecycle transitions
+        assert "ready" in out
+        assert main(["--api", srv.base_url, "endpoint", "healthz",
+                     "3"]) == 0
+        out = capsys.readouterr().out
+        assert '"healthy": true' in out
+        assert main(["--api", srv.base_url, "endpoint", "regenerate",
+                     "3"]) == 0
+        d.wait_for_policy_revision()
+        # unknown endpoint 404s -> SystemExit from the client
+        import pytest as _pytest
+        with _pytest.raises(SystemExit):
+            main(["--api", srv.base_url, "endpoint", "log", "99"])
+    finally:
+        d.shutdown()
+
+
+def test_regenerate_recovers_not_ready_endpoint():
+    """Review regression: the API regenerate path must move the
+    endpoint through WAITING_TO_REGENERATE first, or a failed
+    endpoint's recovery build is silently skipped by the state
+    machine."""
+    import json as _json
+    import urllib.request
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.endpoint import EndpointState
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    try:
+        ep = d.endpoint_create(4, ipv4="10.90.0.4",
+                               labels=["k8s:app=sick"])
+        d.wait_for_policy_revision()
+        # simulate a failed build outcome
+        ep.set_state(EndpointState.WAITING_TO_REGENERATE, "test")
+        ep.set_state(EndpointState.NOT_READY, "simulated failure")
+        req = urllib.request.Request(
+            srv.base_url + "/endpoint/4/regenerate", method="POST",
+            data=b"{}")
+        out = _json.loads(urllib.request.urlopen(req).read())
+        assert out["queued"] is True
+        assert d.endpoints.wait_for_quiesce(timeout=15)
+        assert ep.state == EndpointState.READY
+        # healthz: queued-rebuild window counts healthy
+        ep.set_state(EndpointState.WAITING_TO_REGENERATE, "queued")
+        hz = _json.loads(urllib.request.urlopen(
+            srv.base_url + "/endpoint/4/healthz").read())
+        assert hz["healthy"] is True
+        d.endpoints.queue_regeneration(4)
+        d.endpoints.wait_for_quiesce(timeout=15)
+    finally:
+        d.shutdown()
