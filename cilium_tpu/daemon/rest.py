@@ -155,8 +155,22 @@ class _Handler(BaseHTTPRequestHandler):
                 if ep is None:
                     return self._error(404, "endpoint not found")
                 from ..endpoint import EndpointState as _ES
-                ep.set_state(_ES.WAITING_TO_REGENERATE,
-                             "api regenerate")
+                moved = ep.set_state(_ES.WAITING_TO_REGENERATE,
+                                     "api regenerate")
+                if not moved and ep.state != _ES.WAITING_TO_REGENERATE:
+                    # retry once: a concurrent transition (e.g. identity
+                    # resolution finishing) may have just made the
+                    # endpoint regenerable
+                    moved = ep.set_state(_ES.WAITING_TO_REGENERATE,
+                                         "api regenerate")
+                if not moved and ep.state != _ES.WAITING_TO_REGENERATE:
+                    # the state machine refused (creating /
+                    # waiting-for-identity / disconnecting): the queued
+                    # build would be dropped as skipped-state — say so
+                    # instead of reporting success
+                    return self._error(
+                        409, f"endpoint in state {ep.state!r} "
+                             "cannot regenerate")
                 queued = d.endpoints.queue_regeneration(ep_id)
                 return self._send(200, {"queued": queued})
             m = re.fullmatch(r"/endpoint/(\d+)/healthz", path)

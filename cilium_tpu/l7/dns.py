@@ -20,7 +20,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..compiler.regexc import compile_regex_set
-from ..ops.dfa_ops import (bucket_rows, device_dfa_tables,
+from ..ops.dfa_ops import (bucket_cols, bucket_rows, device_dfa_tables,
                            dfa_match, encode_strings)
 from ..policy.api import CIDRRule, FQDNSelector, Rule
 
@@ -87,14 +87,34 @@ class DNSPolicyEngine:
             self._c_table, self._c_accept, self._c_starts = \
                 device_dfa_tables(self._compiled)
 
+    def encode(self, names: Sequence[str]) -> Optional[np.ndarray]:
+        """Host-side encode: names -> padded byte block (numpy).
+        None when no selectors are configured (nothing to match)."""
+        if self._compiled is None:
+            return None
+        return bucket_rows(bucket_cols(encode_strings(
+            [_canon(n) for n in names], MAX_NAME_LEN)))
+
+    def match_device(self, data):
+        """[B', R] selector hits on device, no synchronization.
+        Selectorless engines have no device program — use
+        match_encoded, which short-circuits."""
+        if self._compiled is None:
+            raise ValueError("selectorless DNS engine has no device match")
+        return dfa_match(self._c_table, self._c_accept, self._c_starts,
+                         jnp.asarray(data))
+
+    def match_encoded(self, data, n: int) -> np.ndarray:
+        """[n, R] selector hits over a pre-encoded block."""
+        if self._compiled is None:
+            return np.zeros((n, 0), bool)
+        return np.asarray(self.match_device(data))[:n]
+
     def match(self, names: Sequence[str]) -> np.ndarray:
         """[B, R] selector hits for a batch of names."""
         if self._compiled is None:
             return np.zeros((len(names), 0), bool)
-        data = jnp.asarray(bucket_rows(encode_strings(
-            [_canon(n) for n in names], MAX_NAME_LEN)))
-        return np.asarray(dfa_match(self._c_table, self._c_accept,
-                                    self._c_starts, data))[:len(names)]
+        return self.match_encoded(self.encode(names), len(names))
 
     def allowed(self, names: Sequence[str]) -> np.ndarray:
         hits = self.match(names)

@@ -22,8 +22,12 @@ import numpy as np
 
 import jax.numpy as jnp
 
+import functools
+
+import jax
+
 from ..compiler.regexc import CompiledRegexSet, compile_regex_set
-from ..ops.dfa_ops import (bucket_rows, device_dfa_tables,
+from ..ops.dfa_ops import (bucket_cols, bucket_rows, device_dfa_tables,
                            dfa_match, encode_strings)
 from ..policy.api import PortRuleHTTP
 
@@ -51,6 +55,22 @@ def _header_regex(header: str) -> str:
     return f".*\\x01{name_re}: [^\\x01]*\\x01.*"
 
 
+@jax.jit
+def _any_rule(rule_hit):
+    return jnp.any(rule_hit, axis=1)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _combine_headers(rule_hit, hdr_hit, hmap, num_rules):
+    """allow[b] = any rule whose regex hit AND whose every header
+    requirement hit.  Rules with no header requirements get a zero
+    miss-count from segment_sum and pass through."""
+    miss = jnp.where(hdr_hit, 0, 1).astype(jnp.int32)        # [B, H]
+    per_rule_miss = jax.ops.segment_sum(
+        miss.T, hmap, num_segments=num_rules)                # [R, B]
+    return jnp.any(rule_hit & (per_rule_miss.T == 0), axis=1)
+
+
 @dataclass
 class HTTPRequest:
     method: str
@@ -67,6 +87,7 @@ class HTTPPolicyEngine:
         if not self.rules:
             # empty rule set == L7 allow-all (wildcarded redirect)
             self._combined = None
+            self._headers = None
             return
         self._combined = compile_regex_set(
             [_rule_to_combined_regex(r) for r in self.rules])
@@ -85,20 +106,27 @@ class HTTPPolicyEngine:
         if self._headers is not None:
             self._h_table, self._h_accept, self._h_starts = \
                 device_dfa_tables(self._headers)
+            # header-pattern -> owning-rule index, device-resident for
+            # the on-device AND-combine in check_encoded
+            hmap = np.zeros(len(header_patterns), np.int32)
+            for ri, (s, e) in enumerate(self._header_slices):
+                hmap[s:e] = ri
+            self._hmap = jnp.asarray(hmap)
 
-    def check(self, requests: Sequence[HTTPRequest]) -> np.ndarray:
-        """Batched verdicts: [B] bool (True == allow)."""
-        if self._combined is None:
-            return np.ones(len(requests), bool)
+    def encode(self, requests: Sequence[HTTPRequest]):
+        """Host-side encode: requests -> padded byte blocks.
+
+        Returns (data, hdata) numpy blocks (hdata None when no rule
+        carries header requirements).  Split from the match so a proxy
+        (or bench) can overlap encoding with device compute and keep
+        hot inputs device-resident."""
+        if self._combined is None:          # allow-all: nothing to match
+            return None, None
         lines = [f"{r.method}\x00{r.path}\x00{(r.host or '').lower()}"
                  for r in requests]
-        b = len(lines)
-        data = jnp.asarray(bucket_rows(
+        data = bucket_rows(bucket_cols(
             encode_strings(lines, MAX_REQUEST_LINE)))
-        rule_hit = np.array(dfa_match(
-            self._c_table, self._c_accept, self._c_starts,
-            data))[:b]                                      # [B, R]
-
+        hdata = None
         if self._headers is not None:
             blocks = []
             for r in requests:
@@ -106,15 +134,40 @@ class HTTPPolicyEngine:
                 canon = "\x01".join(f"{k.lower()}: {v}"
                                     for k, v in sorted(hdrs.items()))
                 blocks.append("\x01" + canon + "\x01")
-            hdata = jnp.asarray(bucket_rows(
+            hdata = bucket_rows(bucket_cols(
                 encode_strings(blocks, MAX_HEADER_BLOCK)))
-            hdr_hit = np.asarray(dfa_match(
-                self._h_table, self._h_accept, self._h_starts,
-                hdata))[:b]                                 # [B, H]
-            for ri, (s, e) in enumerate(self._header_slices):
-                if e > s:
-                    rule_hit[:, ri] &= hdr_hit[:, s:e].all(axis=1)
-        return rule_hit.any(axis=1)
+        return data, hdata
+
+    def match_device(self, data, hdata):
+        """Device verdicts over pre-encoded blocks; [B'] bool on device.
+
+        Does not synchronize: callers can dispatch many batches
+        back-to-back and block once, hiding the host<->device link
+        latency behind in-flight compute.  Allow-all engines have no
+        device program — use check_encoded, which short-circuits."""
+        if self._combined is None:
+            raise ValueError("allow-all HTTP engine has no device match")
+        rule_hit = dfa_match(self._c_table, self._c_accept,
+                             self._c_starts, jnp.asarray(data))  # [B', R]
+        if self._headers is None:
+            return _any_rule(rule_hit)
+        hdr_hit = dfa_match(self._h_table, self._h_accept,
+                            self._h_starts, jnp.asarray(hdata))  # [B', H]
+        return _combine_headers(rule_hit, hdr_hit, self._hmap,
+                                rule_hit.shape[1])
+
+    def check_encoded(self, data, hdata, n: int) -> np.ndarray:
+        """Device verdicts over pre-encoded blocks; [:n] bool allows."""
+        if self._combined is None:
+            return np.ones(n, bool)
+        return np.asarray(self.match_device(data, hdata))[:n]
+
+    def check(self, requests: Sequence[HTTPRequest]) -> np.ndarray:
+        """Batched verdicts: [B] bool (True == allow)."""
+        if self._combined is None:
+            return np.ones(len(requests), bool)
+        data, hdata = self.encode(requests)
+        return self.check_encoded(data, hdata, len(requests))
 
     def check_one(self, request: HTTPRequest) -> bool:
         return bool(self.check([request])[0])

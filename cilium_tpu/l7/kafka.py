@@ -20,6 +20,8 @@ import struct
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ..policy.api import KAFKA_API_KEY_MAP, PortRuleKafka
 
 PRODUCE, FETCH, OFFSETS, METADATA = 0, 1, 2, 3
@@ -124,6 +126,37 @@ class KafkaPolicyEngine:
 
     def __init__(self, rules: Sequence[PortRuleKafka]):
         self.rules = [r.sanitize() for r in rules]
+        # Columnar rule tables for the vectorized batch path: each rule
+        # becomes (allowed-api-key set as a 64-bit mask over keys 0..63,
+        # version, client-id index, topic index).  String fields intern
+        # through _sym so request-side comparisons are integer ==.
+        self._sym: dict = {"": -1}
+        sym = self._intern
+        self._r_keymask = np.array(
+            [self._key_mask(r.api_keys_int) for r in self.rules], np.uint64)
+        self._r_anykey = np.array(
+            [not r.api_keys_int for r in self.rules], bool)
+        self._r_version = np.array(
+            [int(r.api_version) if r.api_version else -1
+             for r in self.rules], np.int64)
+        self._r_client = np.array(
+            [sym(r.client_id) for r in self.rules], np.int64)
+        self._r_topic = np.array(
+            [sym(r.topic) for r in self.rules], np.int64)
+
+    def _intern(self, s: str) -> int:
+        if s not in self._sym:
+            self._sym[s] = len(self._sym) - 1
+        return self._sym[s]
+
+    @staticmethod
+    def _key_mask(keys) -> int:
+        if not keys:
+            return (1 << 64) - 1        # empty == all keys allowed
+        m = 0
+        for k in keys:
+            m |= 1 << (k & 63)
+        return m
 
     def _rule_matches(self, req: KafkaRequest, rule: PortRuleKafka) -> bool:
         """pkg/kafka/policy.go:144 ruleMatches."""
@@ -154,4 +187,48 @@ class KafkaPolicyEngine:
         return False
 
     def check(self, requests: Sequence[KafkaRequest]) -> List[bool]:
-        return [self.allows(r) for r in requests]
+        """Batched verdicts.
+
+        Vectorized over the batch for requests with <=1 topic (the wire
+        parser extracts at most one topic per request, so this is the
+        proxy's whole traffic); multi-topic requests — possible when
+        callers construct KafkaRequest directly — take the exact
+        all-topics-covered scalar path (pkg/kafka/policy.go:200)."""
+        if not self.rules:
+            return [True] * len(requests)
+        n = len(requests)
+        multi = [i for i, r in enumerate(requests) if len(r.topics) > 1]
+        sym = self._sym
+        api_key = np.fromiter((r.api_key for r in requests), np.int64, n)
+        version = np.fromiter((r.api_version for r in requests),
+                              np.int64, n)
+        # unknown client/topic strings map to -2: matches no rule value,
+        # and never collides with the -1 "unset" rule sentinel
+        client = np.fromiter((sym.get(r.client_id, -2) for r in requests),
+                             np.int64, n)
+        # empty-STRING topic is still a topic (scalar path keeps it in
+        # `remaining`): encode as -3 so it matches no rule topic and is
+        # never confused with the -1 "request has no topics" case
+        topic = np.fromiter(
+            ((-3 if r.topics[0] == "" else sym.get(r.topics[0], -2))
+             if r.topics else -1 for r in requests), np.int64, n)
+        has_topic = topic != -1
+
+        in_range = (api_key >= 0) & (api_key < 64)
+        key_ok = self._r_anykey[None, :] | (
+            in_range[:, None] &
+            (((self._r_keymask[None, :] >>
+               (api_key[:, None].clip(0, 63).astype(np.uint64))) & 1) != 0))
+        ver_ok = (self._r_version[None, :] == -1) | \
+            (self._r_version[None, :] == version[:, None])
+        cli_ok = (self._r_client[None, :] == -1) | \
+            (self._r_client[None, :] == client[:, None])
+        # topicless rules cover anything; any rule covers a topicless
+        # request; else the (single) topic must equal the rule's
+        cover = (self._r_topic[None, :] == -1) | \
+            (~has_topic[:, None]) | \
+            (self._r_topic[None, :] == topic[:, None])
+        out = (key_ok & ver_ok & cli_ok & cover).any(axis=1)
+        for i in multi:
+            out[i] = self.allows(requests[i])
+        return out.tolist()

@@ -151,8 +151,13 @@ class BucketVerdictEngine:
 
     def __call__(self, pkt_ep, pkt_ident, pkt_dport, pkt_proto, pkt_dir,
                  pkt_len, pkt_frag=None):
-        arr = lambda x: jnp.asarray(np.asarray(x, np.int32))
-        b = len(np.asarray(pkt_ep))
+        def arr(x):
+            # don't bounce already-device-resident inputs through host
+            if isinstance(x, jax.Array):
+                return x.astype(jnp.int32) if x.dtype != jnp.int32 else x
+            return jnp.asarray(np.asarray(x, np.int32))
+        b = pkt_ep.shape[0] if hasattr(pkt_ep, "shape") \
+            else len(pkt_ep)
         frag = arr(pkt_frag if pkt_frag is not None else np.zeros(b))
         verdict, self.counters = self._step(
             self.key_id, self.key_meta, self.value, self.counters,

@@ -92,6 +92,29 @@ def device_dfa_tables(compiled) -> Tuple[jnp.ndarray, jnp.ndarray,
             jnp.asarray(compiled.starts))
 
 
+def bucket_cols(data: "np.ndarray", min_cols: int = 16) -> "np.ndarray":
+    """Trim a [B, L] block to the power-of-two column count covering the
+    longest real row.
+
+    The DFA scan is sequential in L, so a 40-byte request padded to a
+    512-byte block pays 512 scan steps; trimming to 64 pays 64.  The
+    cap `L` stays the semantic overlong limit (rows poisoned with -2 by
+    encode_strings keep their poison in any column slice).  Power-of-two
+    widths bound the jit program cache exactly like bucket_rows."""
+    import numpy as np
+    b, full = data.shape
+    if b == 0 or full <= min_cols:
+        return data
+    used = np.nonzero((data >= 0).any(axis=0))[0]
+    eff = int(used[-1]) + 1 if used.size else 1
+    cols = min_cols
+    while cols < eff:
+        cols *= 2
+    if cols >= full:
+        return data
+    return np.ascontiguousarray(data[:, :cols])
+
+
 def bucket_rows(data: "np.ndarray", min_rows: int = 16) -> "np.ndarray":
     """Pad a [B, L] block to the next power-of-two row count.
 

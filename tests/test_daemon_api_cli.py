@@ -651,3 +651,28 @@ def test_regenerate_recovers_not_ready_endpoint():
         d.endpoints.wait_for_quiesce(timeout=15)
     finally:
         d.shutdown()
+
+
+def test_regenerate_refused_state_returns_409():
+    """Review regression: when the state machine refuses the move to
+    WAITING_TO_REGENERATE (the build would be dropped as
+    skipped-state), the API must NOT report queued:true."""
+    import urllib.error
+    import urllib.request
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.endpoint import EndpointState
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    try:
+        ep = d.endpoint_create(5, ipv4="10.90.0.5",
+                               labels=["k8s:app=leaving"])
+        d.wait_for_policy_revision()
+        assert ep.set_state(EndpointState.DISCONNECTING, "test")
+        req = urllib.request.Request(
+            srv.base_url + "/endpoint/5/regenerate", method="POST",
+            data=b"{}")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 409
+    finally:
+        d.shutdown()
