@@ -158,12 +158,6 @@ class _Handler(BaseHTTPRequestHandler):
                 moved = ep.set_state(_ES.WAITING_TO_REGENERATE,
                                      "api regenerate")
                 if not moved and ep.state != _ES.WAITING_TO_REGENERATE:
-                    # retry once: a concurrent transition (e.g. identity
-                    # resolution finishing) may have just made the
-                    # endpoint regenerable
-                    moved = ep.set_state(_ES.WAITING_TO_REGENERATE,
-                                         "api regenerate")
-                if not moved and ep.state != _ES.WAITING_TO_REGENERATE:
                     # the state machine refused (creating /
                     # waiting-for-identity / disconnecting): the queued
                     # build would be dropped as skipped-state — say so

@@ -99,6 +99,9 @@ def load() -> ctypes.CDLL:
         lib.vc_lookup_batch.restype = u64
         lib.vc_lookup_batch.argtypes = [vp, p(u32), p(u32), u64,
                                         p(i32), p(u8)]
+        lib.vc_classify_batch.restype = u64
+        lib.vc_classify_batch.argtypes = [vp, p(u32), p(i32), p(i32),
+                                          p(i32), u64, p(i32)]
         lib.vc_len.restype = u64
         lib.vc_len.argtypes = [vp]
         lib.vc_slots.restype = u64
@@ -233,6 +236,27 @@ class VerdictCache:
             values.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
         return values, found.astype(bool)
+
+    def classify_batch(self, identity: np.ndarray, dport: np.ndarray,
+                       proto: np.ndarray, direction: np.ndarray
+                       ) -> np.ndarray:
+        """Full 3-stage __policy_can_access over a batch in one native
+        call (bpf/lib/policy.h:46 semantics; -1 drop, 0 allow, >0
+        proxy port).  The latency path: no per-stage Python round
+        trips."""
+        ident = np.ascontiguousarray(identity, dtype=np.uint32)
+        dpt = np.ascontiguousarray(dport, dtype=np.int32)
+        pro = np.ascontiguousarray(proto, dtype=np.int32)
+        dirn = np.ascontiguousarray(direction, dtype=np.int32)
+        n = len(ident)
+        out = np.empty(n, np.int32)
+        self._lib.vc_classify_batch(
+            self._h, ident.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            dpt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            pro.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dirn.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
 
     def __len__(self) -> int:
         return self._lib.vc_len(self._h)

@@ -16,19 +16,11 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..compiler.policy_tables import pack_key, pack_meta
+from ..compiler.policy_tables import pack_key
 from ..policy.mapstate import PolicyMapState
 from . import VerdictCache, load
 
 VERDICT_DROP = -1
-
-
-def _pack_meta_arrays(dport: np.ndarray, proto: np.ndarray,
-                      direction: np.ndarray) -> np.ndarray:
-    """Vectorized key_b packing — pack_meta's bit ops applied
-    elementwise, so the lockstep layout has one definition."""
-    return pack_meta(dport.astype(np.uint32), proto.astype(np.uint32),
-                     direction.astype(np.uint32))
 
 
 class HostVerdictPath:
@@ -70,40 +62,17 @@ class HostVerdictPath:
                  direction: np.ndarray) -> Optional[np.ndarray]:
         """3-stage verdict for one endpoint's batch; None if the
         endpoint has no cache. Returns int32 verdicts: -1 drop, 0
-        allow, >0 proxy port — identical to the device kernel."""
+        allow, >0 proxy port — identical to the device kernel.
+
+        The whole exact -> L3-only -> L4-wildcard fallback runs in ONE
+        native call (vc_classify_batch): one lock acquisition, zero
+        per-stage Python/numpy round trips, which is what keeps the
+        small-batch latency under the device round trip."""
         with self._lock:
             cache = self._caches.get(endpoint_id)
         if cache is None:
             return None
-        identity = np.asarray(identity, np.uint32)
-        dport = np.asarray(dport)
-        proto = np.asarray(proto)
-        direction = np.asarray(direction)
-        n = len(identity)
-        verdict = np.full(n, VERDICT_DROP, np.int32)
-
-        # stage 1: exact (identity, dport, proto, dir)
-        kb_exact = _pack_meta_arrays(dport, proto, direction)
-        v1, f1 = cache.lookup_batch(identity, kb_exact)
-        verdict[f1] = v1[f1]
-
-        # stage 2: L3-only (identity, 0, 0, dir) — never redirects
-        # (policy.h:83)
-        pending = ~f1
-        if pending.any():
-            kb_l3 = _pack_meta_arrays(np.zeros(n, np.uint32),
-                                      np.zeros(n, np.uint32), direction)
-            _, f2 = cache.lookup_batch(identity, kb_l3)
-            hit2 = pending & f2
-            verdict[hit2] = 0
-            pending &= ~f2
-
-        # stage 3: L4 wildcard (0, dport, proto, dir)
-        if pending.any():
-            v3, f3 = cache.lookup_batch(np.zeros(n, np.uint32), kb_exact)
-            hit3 = pending & f3
-            verdict[hit3] = v3[hit3]
-        return verdict
+        return cache.classify_batch(identity, dport, proto, direction)
 
     def stats(self) -> Dict[int, Dict]:
         with self._lock:

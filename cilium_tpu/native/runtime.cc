@@ -327,6 +327,58 @@ uint64_t vc_lookup_batch(void* h, const uint32_t* ka, const uint32_t* kb,
     return found_count;
 }
 
+// Full 3-stage __policy_can_access (bpf/lib/policy.h:46-110) over a
+// batch in ONE native call: exact (identity,dport,proto,dir) ->
+// L3-only (identity,0,0,dir; never redirects, policy.h:83) ->
+// L4-wildcard (0,dport,proto,dir) -> drop (-1).  One shared-lock
+// acquisition and zero Python/numpy ops on the hot path — this is what
+// lets small latency-critical batches undercut the device round trip.
+// Key packing MUST stay in lockstep with compiler/policy_tables.py
+// pack_key/pack_meta: key_b = (dport<<16)|(proto<<8)|(dir<<1)|1.
+static inline bool vc_find(const VerdictCache* c, uint32_t ka,
+                           uint32_t kb, int32_t* out) {
+    uint32_t hh = hash_mix(ka, kb) & c->mask;
+    for (uint32_t probe = 0; probe <= c->mask; probe++) {
+        uint32_t s = (hh + probe) & c->mask;
+        if (c->key_b[s] == 0) return false;
+        if (c->key_a[s] == ka && c->key_b[s] == kb) {
+            *out = c->value[s];
+            return true;
+        }
+    }
+    return false;
+}
+
+uint64_t vc_classify_batch(void* h, const uint32_t* identity,
+                           const int32_t* dport, const int32_t* proto,
+                           const int32_t* direction, uint64_t n,
+                           int32_t* out_verdict) {
+    VerdictCache* c = static_cast<VerdictCache*>(h);
+    std::shared_lock<std::shared_mutex> lk(c->mu);
+    uint64_t hits = 0;
+    for (uint64_t i = 0; i < n; i++) {
+        uint32_t dir = (uint32_t)direction[i] & 1u;
+        uint32_t kb_exact = (((uint32_t)dport[i] & 0xFFFFu) << 16) |
+                            (((uint32_t)proto[i] & 0xFFu) << 8) |
+                            (dir << 1) | 1u;
+        uint32_t kb_l3 = (dir << 1) | 1u;
+        int32_t v;
+        if (vc_find(c, identity[i], kb_exact, &v)) {
+            out_verdict[i] = v;
+            hits++;
+        } else if (vc_find(c, identity[i], kb_l3, &v)) {
+            out_verdict[i] = 0;  // L3-only match never redirects
+            hits++;
+        } else if (vc_find(c, 0, kb_exact, &v)) {
+            out_verdict[i] = v;
+            hits++;
+        } else {
+            out_verdict[i] = -1;
+        }
+    }
+    return hits;
+}
+
 uint64_t vc_len(void* h) {
     VerdictCache* c = static_cast<VerdictCache*>(h);
     std::shared_lock<std::shared_mutex> lk(c->mu);
