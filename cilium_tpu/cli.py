@@ -379,6 +379,12 @@ def cmd_cni(c: Client, args) -> int:
     return cni.main()
 
 
+def cmd_docker_plugin(c: Client, args) -> int:
+    from . import docker_plugin
+    return docker_plugin.main(["--api", c.base_url,
+                               "--listen-port", str(args.listen_port)])
+
+
 def cmd_agent(args) -> int:
     """Run the agent + API server in the foreground."""
     from .daemon import Daemon
@@ -523,6 +529,10 @@ def build_parser() -> argparse.ArgumentParser:
     cn.add_argument("cni_cmd", choices=["add", "del", "version"])
     cn.add_argument("--container-id", default="")
 
+    dp = sub.add_parser("docker-plugin",
+                        help="serve the docker libnetwork remote driver")
+    dp.add_argument("--listen-port", type=int, default=9235)
+
     ag = sub.add_parser("agent", help="run the agent")
     ag.add_argument("--api-port", type=int, default=9234)
     ag.add_argument("--kvstore", default="none",
@@ -540,6 +550,7 @@ COMMANDS = {
     "prefilter": cmd_prefilter, "monitor": cmd_monitor,
     "config": cmd_config, "metrics": cmd_metrics,
     "bugtool": cmd_bugtool, "cni": cmd_cni,
+    "docker-plugin": cmd_docker_plugin,
     "migrate-state": cmd_migrate_state,
     "node": cmd_node, "map": cmd_map, "version": cmd_version,
 }

@@ -32,6 +32,7 @@ from ..ipcache import (SOURCE_AGENT_LOCAL, IPCache, IPIdentityWatcher,
                        release_cidr_identities)
 from ..kvstore import backend as kvbackend
 from ..kvstore.identity_allocator import DistributedIdentityAllocator
+from ..ipam import HostScopeIPAM, IPAMError
 from ..l7.dns import DNSCache, DNSPoller, inject_to_cidr_set
 from ..labels import Labels
 from ..monitor import MonitorHub
@@ -81,6 +82,18 @@ class Daemon:
         self.dns_cache = DNSCache()
         self.dns_poller: Optional[DNSPoller] = None
         self.started_at = time.time()
+
+        # daemon-owned host-scope IPAM (daemon/ipam.go handlers): the
+        # REST /ipam routes and the docker libnetwork driver allocate
+        # from these; the router IP (offset 1) is the node's gateway
+        self.ipam = HostScopeIPAM(self.config.ipv4_range)
+        self.ipam6 = HostScopeIPAM(self.config.ipv6_range) \
+            if self.config.enable_ipv6 else None
+        self.host_ipv4 = self.ipam.router_ip()
+        # NB: HostScopeIPAM defines __len__, so an empty pool is falsy
+        # — identity checks only
+        self.host_ipv6 = self.ipam6.router_ip() \
+            if self.ipam6 is not None else ""
 
         # the node manager must exist before the registry: registry
         # construction synchronously replays pre-existing nodes into
@@ -364,6 +377,41 @@ class Daemon:
 
     # -------------------------------------------------- endpoints
 
+    def addressing(self) -> Dict:
+        """Node addressing block (models.NodeAddressing analog) served
+        in GET /config — what the docker libnetwork driver and CNI use
+        to build pools/routes (plugins/cilium-docker/driver/driver.go
+        NewDriver's ConfigGet)."""
+        out = {"ipv4": {"ip": self.host_ipv4,
+                        "alloc-range": str(self.ipam.network),
+                        "enabled": self.config.enable_ipv4}}
+        if self.ipam6 is not None:
+            out["ipv6"] = {"ip": self.host_ipv6,
+                           "alloc-range": str(self.ipam6.network),
+                           "enabled": True}
+        return out
+
+    def ipam_allocate(self, family: str = "ipv4",
+                      owner: str = "") -> Dict:
+        """POST /ipam (daemon/ipam.go AllocateIP): next free address
+        of the family, plus current host addressing (the reference
+        returns it so clients can refresh routes after a restart)."""
+        if family not in ("ipv4", "ipv6"):
+            raise IPAMError(f"unknown address family {family!r}")
+        pool = self.ipam6 if family == "ipv6" else self.ipam
+        if pool is None:
+            raise IPAMError(f"family {family!r} not enabled")
+        ip = pool.allocate_next(owner)
+        return {"address": {family: ip},
+                "host-addressing": self.addressing()}
+
+    def ipam_release(self, ip: str) -> bool:
+        """DELETE /ipam/{ip}: release from whichever family owns it."""
+        if self.ipam.release(ip):
+            return True
+        return self.ipam6.release(ip) if self.ipam6 is not None \
+            else False
+
     def endpoint_create(self, endpoint_id: int, ipv4: str = "",
                         container_name: str = "",
                         labels: Optional[Sequence[str]] = None
@@ -487,6 +535,16 @@ class Daemon:
                 self.ipcache.upsert(ep.ipv4, ep.security_identity,
                                     SOURCE_AGENT_LOCAL,
                                     metadata=f"endpoint:{ep.id}")
+                # re-claim the IP in the host-scope allocator so a
+                # post-restart POST /ipam can never hand it out again
+                # (ipam.AllocateIP restore path, daemon/state.go)
+                try:
+                    self.ipam.allocate_ip(ep.ipv4,
+                                          owner=f"endpoint:{ep.id}")
+                except IPAMError:
+                    # outside this node's range (config changed) or
+                    # already claimed — either way not double-bookable
+                    pass
             self.endpoints.queue_regeneration(ep.id)
             n += 1
         return n

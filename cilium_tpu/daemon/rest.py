@@ -3,9 +3,9 @@
 Mirrors the reference's OpenAPI surface (api/v1/openapi.yaml) core
 paths: /healthz, /config, /policy, /policy/resolve, /endpoint,
 /endpoint/{id}, /endpoint/{id}/config, /identity, /identity/{id},
-/service, /prefilter, plus /metrics (Prometheus text) and /monitor
-(event tail). Stdlib http.server — the reference serves REST over a
-unix socket; here TCP on localhost for the CLI.
+/service, /prefilter, /ipam (+ /ipam/{ip}), plus /metrics (Prometheus
+text) and /monitor (event tail). Stdlib http.server — the reference
+serves REST over a unix socket; here TCP on localhost for the CLI.
 """
 
 from __future__ import annotations
@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -72,6 +73,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if method == "GET":
                     return self._send(200, {
                         "daemon": d.config.opts.dump(),
+                        "addressing": d.addressing(),
                         "cluster": {"name": d.config.cluster_name,
                                     "id": d.config.cluster_id}})
                 if method == "PATCH":
@@ -98,6 +100,25 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, d.policy_resolve(
                     frm, to, dports=body.get("dports"),
                     verbose=bool(body.get("verbose"))))
+            if path == "/ipam" and method == "POST":
+                # daemon/ipam.go AllocateIP analog
+                body = json.loads(self._body() or b"{}")
+                family = body.get("family", "ipv4")
+                if family not in ("ipv4", "ipv6"):
+                    return self._error(
+                        400, f"unknown address family {family!r}")
+                from ..ipam import IPAMError as _IPAMError
+                try:
+                    out = d.ipam_allocate(family,
+                                          owner=body.get("owner", ""))
+                except _IPAMError as e:
+                    return self._error(502, str(e))
+                return self._send(201, out)
+            m = re.fullmatch(r"/ipam/([0-9a-fA-F.:]+)", path)
+            if m and method == "DELETE":
+                if not d.ipam_release(m.group(1)):
+                    return self._error(404, "address not allocated")
+                return self._send(200, {"released": m.group(1)})
             if path == "/endpoint" and method == "GET":
                 return self._send(200, [ep.model()
                                         for ep in d.endpoints.endpoints()])
@@ -155,13 +176,21 @@ class _Handler(BaseHTTPRequestHandler):
                 if ep is None:
                     return self._error(404, "endpoint not found")
                 from ..endpoint import EndpointState as _ES
-                moved = ep.set_state(_ES.WAITING_TO_REGENERATE,
-                                     "api regenerate")
+                # set_state can lose a race with a concurrent
+                # transition (identity resolution finishing, a build
+                # completing); retry briefly before concluding the
+                # state machine genuinely refuses — a refused move
+                # means the queued build would be dropped as
+                # skipped-state, which must surface as 409, not as a
+                # false queued:true
+                moved = False
+                for _ in range(3):
+                    moved = ep.set_state(_ES.WAITING_TO_REGENERATE,
+                                         "api regenerate")
+                    if moved or ep.state == _ES.WAITING_TO_REGENERATE:
+                        break
+                    time.sleep(0.05)
                 if not moved and ep.state != _ES.WAITING_TO_REGENERATE:
-                    # the state machine refused (creating /
-                    # waiting-for-identity / disconnecting): the queued
-                    # build would be dropped as skipped-state — say so
-                    # instead of reporting success
                     return self._error(
                         409, f"endpoint in state {ep.state!r} "
                              "cannot regenerate")

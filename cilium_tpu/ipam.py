@@ -31,6 +31,10 @@ class HostScopeIPAM:
     def _at(self, offset: int) -> str:
         return str(self.network.network_address + offset)
 
+    def router_ip(self) -> str:
+        """The reserved router/gateway address (first host IP)."""
+        return self._at(1)
+
     def allocate_next(self, owner: str = "") -> str:
         """Next free IP (ipam.AllocateNext)."""
         with self._lock:

@@ -109,6 +109,8 @@ def load() -> ctypes.CDLL:
         lib.vc_flush.argtypes = [vp]
         lib.vc_hash_mix.restype = u32
         lib.vc_hash_mix.argtypes = [u32, u32]
+        lib.vc_pack_meta.restype = u32
+        lib.vc_pack_meta.argtypes = [u32, u32, u32]
         _lib = lib
         return lib
 

@@ -335,6 +335,19 @@ uint64_t vc_lookup_batch(void* h, const uint32_t* ka, const uint32_t* kb,
 // lets small latency-critical batches undercut the device round trip.
 // Key packing MUST stay in lockstep with compiler/policy_tables.py
 // pack_key/pack_meta: key_b = (dport<<16)|(proto<<8)|(dir<<1)|1.
+// MUST stay in lockstep with compiler/policy_tables.py pack_meta —
+// exported as vc_pack_meta (like vc_hash_mix) so the Python side can
+// lockstep-test the layout instead of trusting a comment.
+static inline uint32_t pack_meta_c(uint32_t dport, uint32_t proto,
+                                   uint32_t dir) {
+    return ((dport & 0xFFFFu) << 16) | ((proto & 0xFFu) << 8) |
+           ((dir & 1u) << 1) | 1u;
+}
+
+uint32_t vc_pack_meta(uint32_t dport, uint32_t proto, uint32_t dir) {
+    return pack_meta_c(dport, proto, dir);
+}
+
 static inline bool vc_find(const VerdictCache* c, uint32_t ka,
                            uint32_t kb, int32_t* out) {
     uint32_t hh = hash_mix(ka, kb) & c->mask;
@@ -358,10 +371,9 @@ uint64_t vc_classify_batch(void* h, const uint32_t* identity,
     uint64_t hits = 0;
     for (uint64_t i = 0; i < n; i++) {
         uint32_t dir = (uint32_t)direction[i] & 1u;
-        uint32_t kb_exact = (((uint32_t)dport[i] & 0xFFFFu) << 16) |
-                            (((uint32_t)proto[i] & 0xFFu) << 8) |
-                            (dir << 1) | 1u;
-        uint32_t kb_l3 = (dir << 1) | 1u;
+        uint32_t kb_exact = pack_meta_c((uint32_t)dport[i],
+                                        (uint32_t)proto[i], dir);
+        uint32_t kb_l3 = pack_meta_c(0, 0, dir);
         int32_t v;
         if (vc_find(c, identity[i], kb_exact, &v)) {
             out_verdict[i] = v;

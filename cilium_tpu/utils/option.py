@@ -199,6 +199,10 @@ class DaemonConfig:
     cluster_name: str = "default"
     cluster_id: int = 0
     state_dir: str = "/var/run/cilium_tpu"
+    # node pod CIDRs served by the daemon's host-scope IPAM
+    # (reference: daemon/ipam.go AllocateIP + pkg/ipam)
+    ipv4_range: str = "10.200.0.0/16"
+    ipv6_range: str = "f00d::/96"
     device_count: int = 1
     tunnel: str = "vxlan"              # vxlan | geneve | disabled
     enable_ipv4: bool = True

@@ -1,0 +1,196 @@
+"""Docker libnetwork driver: the full docker-side lifecycle against a
+live agent.
+
+Mirrors the reference plugin's flow (plugins/cilium-docker/driver):
+Activate -> pools -> RequestAddress -> CreateEndpoint -> Join ->
+Leave -> ReleaseAddress, plus the error paths (duplicate endpoint,
+missing address, unknown method).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from cilium_tpu.cli import Client
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.daemon.daemon import DaemonConfig
+from cilium_tpu.daemon.rest import APIServer
+from cilium_tpu.docker_plugin import (LibnetworkDriver, PluginError,
+                                      PluginServer, endpoint_id_for)
+
+
+@pytest.fixture()
+def agent():
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    yield d, srv
+    d.shutdown()
+
+
+def _post(base, method, body=None):
+    req = urllib.request.Request(
+        f"{base}/{method}", method="POST",
+        data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_full_docker_lifecycle_over_http(agent):
+    d, srv = agent
+    driver = LibnetworkDriver(Client(srv.base_url), wait_tries=2)
+    ps = PluginServer(driver).start()
+    try:
+        code, out = _post(ps.base_url, "Plugin.Activate")
+        assert code == 200
+        assert out["Implements"] == ["NetworkDriver", "IpamDriver"]
+
+        code, out = _post(ps.base_url, "NetworkDriver.GetCapabilities")
+        assert out == {"Scope": "local"}
+
+        code, out = _post(ps.base_url, "IpamDriver.RequestPool",
+                          {"V6": False})
+        assert out["PoolID"] == "CiliumPoolv4"
+        gw = out["Data"]["com.docker.network.gateway"]
+        assert gw.endswith("/32") and gw.startswith("10.200.")
+
+        code, addr = _post(ps.base_url, "IpamDriver.RequestAddress",
+                           {"PoolID": "CiliumPoolv4"})
+        assert code == 200 and addr["Address"].endswith("/32")
+        ip = addr["Address"].split("/")[0]
+        assert ip in d.ipam.allocated()
+
+        eid = "dockerep-0011223344556677"
+        code, out = _post(ps.base_url, "NetworkDriver.CreateEndpoint", {
+            "NetworkID": "net-1", "EndpointID": eid,
+            "Interface": {"Address": addr["Address"]}})
+        assert code == 200, out
+        ep = d.endpoints.lookup(endpoint_id_for(eid))
+        assert ep is not None and ep.ipv4 == ip
+        lbls = [str(l) for l in ep.labels]
+        assert any("docker-endpoint" in l for l in lbls)
+
+        # duplicate create fails like driver.go:305
+        code, out = _post(ps.base_url, "NetworkDriver.CreateEndpoint", {
+            "NetworkID": "net-1", "EndpointID": eid,
+            "Interface": {"Address": addr["Address"]}})
+        assert code == 400 and "exists" in out["Err"]
+
+        code, join = _post(ps.base_url, "NetworkDriver.Join",
+                           {"EndpointID": eid})
+        assert code == 200
+        assert join["InterfaceName"]["DstPrefix"] == "cilium"
+        assert join["DisableGatewayService"] is True
+        dests = [r["Destination"] for r in join["StaticRoutes"]]
+        assert "0.0.0.0/0" in dests  # default route via the gateway
+
+        code, _ = _post(ps.base_url, "NetworkDriver.Leave",
+                        {"EndpointID": eid})
+        assert code == 200
+        assert d.endpoints.lookup(endpoint_id_for(eid)) is None
+
+        code, _ = _post(ps.base_url, "IpamDriver.ReleaseAddress",
+                        {"Address": ip})
+        assert code == 200
+        assert ip not in d.ipam.allocated()
+    finally:
+        ps.shutdown()
+
+
+def test_error_paths(agent):
+    d, srv = agent
+    driver = LibnetworkDriver(Client(srv.base_url), wait_tries=2)
+    # missing IPv4 address (the v4-first inversion of driver.go:291)
+    with pytest.raises(PluginError):
+        driver.handle("NetworkDriver.CreateEndpoint",
+                      {"EndpointID": "x", "Interface": {}})
+    # join of an unknown endpoint
+    with pytest.raises(PluginError):
+        driver.handle("NetworkDriver.Join", {"EndpointID": "nope"})
+    # unknown method
+    with pytest.raises(PluginError):
+        driver.handle("NetworkDriver.Frobnicate", {})
+    # leave is idempotent: unknown endpoint does not raise
+    assert driver.handle("NetworkDriver.Leave",
+                         {"EndpointID": "nope"}) == {}
+    # v6 pool reflects the daemon's v6 alloc range
+    pool = driver.handle("IpamDriver.RequestPool", {"V6": True})
+    assert pool["PoolID"] == "CiliumPoolv6"
+    assert pool["Pool"] == str(d.ipam6.network)
+
+
+def test_ipam_rest_routes(agent):
+    d, srv = agent
+    c = Client(srv.base_url)
+    out = c.post("/ipam", {"family": "ipv4", "owner": "test"})
+    ip = out["address"]["ipv4"]
+    assert ip in d.ipam.allocated()
+    assert out["host-addressing"]["ipv4"]["ip"] == d.host_ipv4
+    assert c.delete(f"/ipam/{ip}") == {"released": ip}
+    # double release 404s
+    with pytest.raises(SystemExit):
+        c.delete(f"/ipam/{ip}")
+    # v6 family allocates from the v6 pool
+    out6 = c.post("/ipam", {"family": "ipv6"})
+    assert ":" in out6["address"]["ipv6"]
+    # addressing is visible in /config for plugin bootstrap
+    conf = c.get("/config")
+    assert conf["addressing"]["ipv4"]["alloc-range"] == \
+        str(d.ipam.network)
+
+
+def test_ipam_unknown_family_is_400(agent):
+    d, srv = agent
+    c = Client(srv.base_url)
+    before = len(d.ipam)
+    with pytest.raises(SystemExit) as exc:
+        c.post("/ipam", {"family": "IPv6"})  # case-sensitive contract
+    assert "400" in str(exc.value)
+    assert len(d.ipam) == before  # nothing leaked from the v4 pool
+
+
+def test_restore_reclaims_allocated_ips(tmp_path):
+    """Review regression: after a restart, restored endpoints' IPs must
+    be re-claimed in the host-scope allocator, or POST /ipam hands out
+    an address already in use (daemon/state.go restore + AllocateIP)."""
+    state = str(tmp_path / "state")
+    d1 = Daemon(config=DaemonConfig(state_dir=state))
+    ip = d1.ipam_allocate("ipv4")["address"]["ipv4"]
+    d1.endpoint_create(77, ipv4=ip, labels=["k8s:app=web"])
+    assert d1.wait_for_quiesce(10)
+    d1.shutdown()
+
+    d2 = Daemon(config=DaemonConfig(state_dir=state))
+    assert d2.restore_endpoints() == 1
+    fresh = d2.ipam_allocate("ipv4")["address"]["ipv4"]
+    assert fresh != ip
+    assert ip in d2.ipam.allocated()
+    d2.shutdown()
+
+
+def test_pack_meta_lockstep():
+    """The C++ packing used by vc_classify_batch must equal
+    compiler/policy_tables.py pack_meta (like the vc_hash_mix
+    lockstep)."""
+    import numpy as np
+    from cilium_tpu.compiler.policy_tables import pack_meta
+    from cilium_tpu.native import load
+    lib = load()
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        dport = int(rng.integers(0, 1 << 16))
+        proto = int(rng.integers(0, 256))
+        dirn = int(rng.integers(0, 2))
+        assert lib.vc_pack_meta(dport, proto, dirn) == \
+            pack_meta(dport, proto, dirn)
+
+
+def test_driver_waits_for_daemon():
+    # daemon not running: bounded retries then a clear error
+    with pytest.raises(PluginError):
+        LibnetworkDriver(Client("http://127.0.0.1:1"), wait_tries=2,
+                         wait_base_s=0.0)
