@@ -138,7 +138,9 @@ def bench_http_regex(on_accel: bool):
                           host="admin\\.example\\.com")]
     eng = HTTPPolicyEngine(rules)
     rng = np.random.default_rng(5)
-    batch = 8192 if on_accel else 2048
+    # accel batch sized to amortize per-dispatch link overhead (the
+    # tunneled-TPU environment serializes ~ms per launch)
+    batch = 32768 if on_accel else 2048
     paths = ["/public/idx.html", "/api/v2/users/42", "/api/v2/orders",
              "/secret/x", "/admin/panel", "/api/vX/users/1"]
     methods = ["GET", "POST", "PUT"]
@@ -200,7 +202,7 @@ def bench_fqdn(on_accel: bool):
             FQDNSelector(match_name="api.internal.svc"),
             FQDNSelector(match_pattern="db-*.prod.local")]
     eng = DNSPolicyEngine(sels)
-    batch = 8192 if on_accel else 2048
+    batch = 32768 if on_accel else 2048
     names = [f"host{i}.example.com" if i % 2 else f"db-{i}.prod.local"
              for i in range(batch)]
     import jax.numpy as jnp

@@ -9,13 +9,13 @@ binary serves both roles (like the reference's single distribution).
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import sys
 from typing import Dict, Optional
 
 from .cli import Client
+from .endpoint.ids import CNI_ID_BASE, stable_endpoint_id
 
 CNI_VERSION = "0.3.1"
 
@@ -23,8 +23,7 @@ CNI_VERSION = "0.3.1"
 def _endpoint_id_for(container_id: str) -> int:
     """Stable endpoint id derived from the container id (the reference
     derives it from the interface; any stable mapping works)."""
-    h = hashlib.sha256(container_id.encode()).digest()
-    return 10_000 + int.from_bytes(h[:4], "big") % 1_000_000
+    return stable_endpoint_id(container_id, CNI_ID_BASE)
 
 
 def cni_add(client: Client, container_id: str, netns: str = "",

@@ -432,6 +432,15 @@ class Daemon:
             self.ipcache.upsert(ipv4, ep.security_identity,
                                 SOURCE_AGENT_LOCAL,
                                 metadata=f"endpoint:{endpoint_id}")
+            # claim the IP in the host-scope allocator so POST /ipam
+            # can never hand it out while this endpoint lives; if a
+            # prior /ipam allocation (docker flow) already holds it,
+            # that claim stands and its owner releases it
+            try:
+                self.ipam.allocate_ip(ipv4,
+                                      owner=f"endpoint:{endpoint_id}")
+            except IPAMError:
+                pass  # outside the pool, or already claimed
         self.endpoints.queue_regeneration(endpoint_id)
         return ep
 
@@ -442,6 +451,10 @@ class Daemon:
         ep.set_state(EndpointState.DISCONNECTING, "delete")
         if ep.ipv4:
             self.ipcache.delete(ep.ipv4, SOURCE_AGENT_LOCAL)
+            # free only our own lifecycle claim (docker-flow addresses
+            # are released by IpamDriver.ReleaseAddress)
+            self.ipam.release_if_owner(ep.ipv4,
+                                       f"endpoint:{endpoint_id}")
         for rid in list(ep.proxy_redirects):
             self.proxy.remove_redirect(rid)
         ep.proxy_redirects = {}

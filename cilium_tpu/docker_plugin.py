@@ -30,7 +30,6 @@ LibnetworkDriver.handle() so tests can drive it with plain dicts.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import threading
 import time
@@ -38,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from .cli import Client
+from .endpoint.ids import DOCKER_ID_BASE, stable_endpoint_id
 
 POOL_V4 = "CiliumPoolv4"
 POOL_V6 = "CiliumPoolv6"
@@ -52,8 +52,7 @@ def endpoint_id_for(docker_endpoint_id: str) -> int:
     """Stable numeric endpoint id from docker's endpoint UUID (the
     reference derives it from the v6 address's low bits,
     addressing.CiliumIPv6.EndpointID; any stable mapping works)."""
-    h = hashlib.sha256(docker_endpoint_id.encode()).digest()
-    return 20_000 + int.from_bytes(h[:4], "big") % 1_000_000
+    return stable_endpoint_id(docker_endpoint_id, DOCKER_ID_BASE)
 
 
 class LibnetworkDriver:

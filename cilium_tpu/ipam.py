@@ -69,6 +69,18 @@ class HostScopeIPAM:
             return self._allocated.pop(str(ipaddress.ip_address(ip)),
                                        None) is not None
 
+    def release_if_owner(self, ip: str, owner: str) -> bool:
+        """Release only when `owner` still holds the address — lets
+        the endpoint lifecycle free its own claims without stealing an
+        address a different allocator client (e.g. the docker IPAM
+        flow) is responsible for releasing."""
+        key = str(ipaddress.ip_address(ip))
+        with self._lock:
+            if self._allocated.get(key) == owner:
+                del self._allocated[key]
+                return True
+            return False
+
     def allocated(self) -> Dict[str, str]:
         with self._lock:
             return dict(self._allocated)

@@ -86,6 +86,15 @@ class DNSPolicyEngine:
         if self._compiled is not None:
             self._c_table, self._c_accept, self._c_starts = \
                 device_dfa_tables(self._compiled)
+        # C++ walker over the same tables for single live lookups
+        # (two-tier, like l7/http.py); optional native build
+        self._scalar = None
+        if self._compiled is not None:
+            try:
+                from ..native import ScalarDFA
+                self._scalar = ScalarDFA(self._compiled)
+            except (RuntimeError, OSError):
+                pass
 
     def encode(self, names: Sequence[str]) -> Optional[np.ndarray]:
         """Host-side encode: names -> padded byte block (numpy).
@@ -121,6 +130,17 @@ class DNSPolicyEngine:
         if hits.shape[1] == 0:
             return np.zeros(len(names), bool)
         return hits.any(axis=1)
+
+    def allowed_one(self, name: str) -> bool:
+        """One live lookup — native scalar walk when available."""
+        if self._compiled is None:
+            return False
+        if self._scalar is None:
+            return bool(self.allowed([name])[0])
+        data = _canon(name).encode()
+        if len(data) > MAX_NAME_LEN:
+            return False
+        return bool(self._scalar.match(data).any())
 
 
 def inject_to_cidr_set(rule: Rule, cache: DNSCache,

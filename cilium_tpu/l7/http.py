@@ -112,6 +112,19 @@ class HTTPPolicyEngine:
             for ri, (s, e) in enumerate(self._header_slices):
                 hmap[s:e] = ri
             self._hmap = jnp.asarray(hmap)
+        # two-tier, like the verdict path: single live requests walk
+        # the SAME compiled tables in C++ (envoy/cilium_l7policy.cc
+        # analog) instead of paying a device round trip; batches go to
+        # the TPU kernel.  Native build is optional — check_one falls
+        # back to the batched path without it.
+        try:
+            from ..native import ScalarDFA
+            self._scalar = ScalarDFA(self._combined)
+            self._h_scalar = ScalarDFA(self._headers) \
+                if self._headers is not None else None
+        except (RuntimeError, OSError):
+            self._scalar = None
+            self._h_scalar = None
 
     def encode(self, requests: Sequence[HTTPRequest]):
         """Host-side encode: requests -> padded byte blocks.
@@ -170,4 +183,31 @@ class HTTPPolicyEngine:
         return self.check_encoded(data, hdata, len(requests))
 
     def check_one(self, request: HTTPRequest) -> bool:
-        return bool(self.check([request])[0])
+        """One live request — the proxy's per-connection path."""
+        if self._combined is None:
+            return True
+        if self._scalar is None:
+            return bool(self.check([request])[0])
+        r = request
+        line = f"{r.method}\x00{r.path}\x00{(r.host or '').lower()}" \
+            .encode()
+        if len(line) > MAX_REQUEST_LINE:
+            return False  # overlong never matches (encode_strings -2)
+        rule_hit = self._scalar.match(line)                # [R]
+        if self._h_scalar is not None and rule_hit.any():
+            hdrs = r.headers or {}
+            canon = "\x01".join(f"{k.lower()}: {v}"
+                                for k, v in sorted(hdrs.items()))
+            block = ("\x01" + canon + "\x01").encode()
+            if len(block) > MAX_HEADER_BLOCK:
+                # overlong block poisons the HEADER patterns only
+                # (encode_strings -2 row): rules with header
+                # requirements fail, header-less rules still stand —
+                # same as the batched path
+                hdr_hit = np.zeros(self._h_scalar.num_regex, bool)
+            else:
+                hdr_hit = self._h_scalar.match(block)      # [H]
+            for ri, (s, e) in enumerate(self._header_slices):
+                if e > s:
+                    rule_hit[ri] &= hdr_hit[s:e].all()
+        return bool(rule_hit.any())

@@ -111,6 +111,9 @@ def load() -> ctypes.CDLL:
         lib.vc_hash_mix.argtypes = [u32, u32]
         lib.vc_pack_meta.restype = u32
         lib.vc_pack_meta.argtypes = [u32, u32, u32]
+        lib.dfa_match_scalar.restype = u64
+        lib.dfa_match_scalar.argtypes = [p(i32), p(u8), p(i32), u64,
+                                         p(u8), u64, p(u8)]
         _lib = lib
         return lib
 
@@ -280,3 +283,35 @@ class VerdictCache:
             self.close()
         except Exception:
             pass
+
+
+class ScalarDFA:
+    """Host-side walker over a compiled stacked DFA table — the live
+    proxy's per-request match (envoy/cilium_l7policy.cc analog).  Holds
+    contiguous copies of the SAME arrays the device kernel uses
+    (compiler/regexc.CompiledRegexSet), so host and TPU verdicts share
+    one compiled artifact."""
+
+    def __init__(self, compiled):
+        self._lib = load()
+        self._table = np.ascontiguousarray(compiled.table, np.int32)
+        self._accept = np.ascontiguousarray(
+            compiled.accept.astype(np.uint8))
+        self._starts = np.ascontiguousarray(compiled.starts, np.int32)
+        self.num_regex = len(self._starts)
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        pu8 = ctypes.POINTER(ctypes.c_uint8)
+        self._t = self._table.ctypes.data_as(p32)
+        self._a = self._accept.ctypes.data_as(pu8)
+        self._s = self._starts.ctypes.data_as(p32)
+
+    def match(self, data: bytes) -> np.ndarray:
+        """[R] bool anchored-match mask for one byte string."""
+        out = np.empty(self.num_regex, np.uint8)
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
+            if data else (ctypes.c_uint8 * 1)()
+        self._lib.dfa_match_scalar(
+            self._t, self._a, self._s, self.num_regex,
+            ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), len(data),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        return out.astype(bool)

@@ -391,6 +391,33 @@ uint64_t vc_classify_batch(void* h, const uint32_t* identity,
     return hits;
 }
 
+// ---------------------------------------------------------------------------
+// Scalar DFA walk: the live proxy's per-request L7 verdict path.
+//
+// The envoy/cilium_l7policy.cc analog: the reference enforces HTTP
+// rules inside Envoy's C++ filter chain; here the SAME stacked DFA
+// tables the TPU batch kernel uses (compiler/regexc.py: table [S,256]
+// int32, accept [S] u8, starts [R] i32, state 0 = dead) are walked in
+// native code for single in-flight requests, so a live connection
+// never pays a device round trip.  Two-tier, like the verdict path:
+// C++ for latency, TPU for bulk.
+// ---------------------------------------------------------------------------
+
+uint64_t dfa_match_scalar(const int32_t* table, const uint8_t* accept,
+                          const int32_t* starts, uint64_t n_regex,
+                          const uint8_t* data, uint64_t len,
+                          uint8_t* out_hit) {
+    uint64_t hits = 0;
+    for (uint64_t r = 0; r < n_regex; r++) {
+        int32_t state = starts[r];
+        for (uint64_t i = 0; i < len && state != 0; i++)
+            state = table[(uint64_t)state * 256 + data[i]];
+        out_hit[r] = accept[state] ? 1 : 0;
+        hits += out_hit[r];
+    }
+    return hits;
+}
+
 uint64_t vc_len(void* h) {
     VerdictCache* c = static_cast<VerdictCache*>(h);
     std::shared_lock<std::shared_mutex> lk(c->mu);

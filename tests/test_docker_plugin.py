@@ -172,6 +172,28 @@ def test_restore_reclaims_allocated_ips(tmp_path):
     d2.shutdown()
 
 
+def test_endpoint_create_claims_ip_in_ipam(agent):
+    """Review regression: a CNI/REST-created endpoint's IP must be
+    claimed in the host-scope allocator while it lives, and freed when
+    the endpoint goes — without stealing docker-flow claims."""
+    d, srv = agent
+    # 10.200.0.2 is the allocator's first free address; create an
+    # endpoint on it directly (the CNI ADD shape)
+    d.endpoint_create(901, ipv4="10.200.0.2", labels=["k8s:a=b"])
+    fresh = d.ipam_allocate("ipv4")["address"]["ipv4"]
+    assert fresh != "10.200.0.2"
+    # lifecycle release: delete frees the endpoint's own claim
+    d.endpoint_delete(901)
+    assert "10.200.0.2" not in d.ipam.allocated()
+    # docker-flow claim ("docker" owner) is NOT freed by endpoint
+    # delete; IpamDriver.ReleaseAddress remains responsible
+    ip = d.ipam_allocate("ipv4", owner="docker")["address"]["ipv4"]
+    d.endpoint_create(902, ipv4=ip, labels=["k8s:a=b"])
+    d.endpoint_delete(902)
+    assert ip in d.ipam.allocated()
+    assert d.ipam_release(ip)
+
+
 def test_pack_meta_lockstep():
     """The C++ packing used by vc_classify_batch must equal
     compiler/policy_tables.py pack_meta (like the vc_hash_mix

@@ -145,6 +145,80 @@ def test_dns_selectorless_engine_encoded_path():
         eng.match_device(None)
 
 
+def test_scalar_dfa_matches_device_dfa():
+    """The C++ walker (live-request path) must agree with the device
+    kernel on the same compiled tables, byte for byte."""
+    import jax.numpy as jnp
+    from cilium_tpu.compiler.regexc import compile_regex_set
+    from cilium_tpu.native import ScalarDFA
+    from cilium_tpu.ops.dfa_ops import dfa_match, encode_strings
+    pats = ["GET\x00/a.*", "(ab|cd)+x?", ".*zz.*", "[a-m]{3,9}"]
+    c = compile_regex_set(pats)
+    scalar = ScalarDFA(c)
+    rng = np.random.default_rng(4)
+    texts = ["GET\x00/abc", "ababx", "qqzzq", "abcdef", "", "zz",
+             "GET\x00/b", "cdx"]
+    texts += ["".join(chr(rng.integers(97, 123)) for _ in range(
+        rng.integers(0, 12))) for _ in range(40)]
+    data = jnp.asarray(encode_strings(texts, 32))
+    dev = np.asarray(dfa_match(jnp.asarray(c.table),
+                               jnp.asarray(c.accept),
+                               jnp.asarray(c.starts), data))
+    for i, t in enumerate(texts):
+        got = scalar.match(t.encode())
+        assert (got == dev[i]).all(), (t, got, dev[i])
+
+
+def test_http_check_one_scalar_matches_batched():
+    rules = [PortRuleHTTP(method="GET", path="/api/.*"),
+             PortRuleHTTP(method="POST", path="/up",
+                          headers=("x-token secret",)),
+             PortRuleHTTP(method="PUT", path="/admin/.*",
+                          host="a\\.example\\.com")]
+    eng = HTTPPolicyEngine(rules)
+    assert eng._scalar is not None, "native walker must build here"
+    reqs = [HTTPRequest("GET", "/api/1"),
+            HTTPRequest("GET", "/api/" + "x" * 600),  # overlong line
+            HTTPRequest("POST", "/up", headers={"X-Token": "secret"}),
+            HTTPRequest("POST", "/up", headers={"X-Token": "no"}),
+            HTTPRequest("POST", "/up"),
+            HTTPRequest("PUT", "/admin/x", host="a.example.com"),
+            HTTPRequest("PUT", "/admin/x", host="b.example.com"),
+            HTTPRequest("HEAD", "/api/1")]
+    batched = eng.check(reqs)
+    for i, r in enumerate(reqs):
+        assert eng.check_one(r) == bool(batched[i]), (i, r)
+
+
+def test_check_one_overlong_headers_keep_headerless_rules():
+    """Review regression: an overlong header block poisons only the
+    header patterns — a matching header-less rule must still allow,
+    exactly like the batched path."""
+    rules = [PortRuleHTTP(method="GET", path="/api/.*"),
+             PortRuleHTTP(method="POST", path="/up",
+                          headers=("x-token secret",))]
+    eng = HTTPPolicyEngine(rules)
+    big = {"cookie": "x" * 2000}
+    allowed_req = HTTPRequest("GET", "/api/1", headers=big)
+    denied_req = HTTPRequest("POST", "/up", headers=big)
+    assert bool(eng.check([allowed_req])[0]) is True
+    assert eng.check_one(allowed_req) is True
+    assert bool(eng.check([denied_req])[0]) is False
+    assert eng.check_one(denied_req) is False
+
+
+def test_dns_allowed_one_matches_batched():
+    eng = DNSPolicyEngine([FQDNSelector(match_pattern="*.example.com"),
+                           FQDNSelector(match_name="db.internal")])
+    assert eng._scalar is not None
+    names = ["a.example.com", "db.internal", "DB.INTERNAL.",
+             "evil.com", "x" * 300 + ".example.com"]
+    batched = eng.allowed(names)
+    for i, n in enumerate(names):
+        assert eng.allowed_one(n) == bool(batched[i]), n
+    assert DNSPolicyEngine([]).allowed_one("a.com") is False
+
+
 def test_dns_encoded_path_matches_allowed():
     eng = DNSPolicyEngine([FQDNSelector(match_pattern="*.example.com"),
                            FQDNSelector(match_name="db.internal")])
