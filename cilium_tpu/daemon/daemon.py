@@ -417,7 +417,25 @@ class Daemon:
                         labels: Optional[Sequence[str]] = None
                         ) -> Endpoint:
         """PUT /endpoint/{id} (daemon/endpoint.go + CNI ADD path):
-        allocate identity, publish ip->identity, queue first build."""
+        allocate identity, publish ip->identity, queue first build.
+
+        Claims the IP in the host-scope allocator FIRST: an address
+        another live endpoint already holds is a hard conflict
+        (IPAMError -> 409), while a docker-flow claim ("docker" owner
+        from POST /ipam) is the expected hand-off and stands."""
+        if ipv4:
+            try:
+                self.ipam.allocate_ip(ipv4,
+                                      owner=f"endpoint:{endpoint_id}")
+            except IPAMError:
+                holder = self.ipam.owner_of(ipv4)
+                if holder is not None and \
+                        holder.startswith("endpoint:") and \
+                        holder != f"endpoint:{endpoint_id}":
+                    raise IPAMError(
+                        f"{ipv4} already in use by {holder}")
+                # outside the pool, or a non-endpoint claim (docker
+                # flow) whose owner releases it — proceed
         ep = Endpoint(endpoint_id, ipv4=ipv4,
                       container_name=container_name,
                       opts=self.config.opts.fork())
@@ -432,15 +450,6 @@ class Daemon:
             self.ipcache.upsert(ipv4, ep.security_identity,
                                 SOURCE_AGENT_LOCAL,
                                 metadata=f"endpoint:{endpoint_id}")
-            # claim the IP in the host-scope allocator so POST /ipam
-            # can never hand it out while this endpoint lives; if a
-            # prior /ipam allocation (docker flow) already holds it,
-            # that claim stands and its owner releases it
-            try:
-                self.ipam.allocate_ip(ipv4,
-                                      owner=f"endpoint:{endpoint_id}")
-            except IPAMError:
-                pass  # outside the pool, or already claimed
         self.endpoints.queue_regeneration(endpoint_id)
         return ep
 

@@ -18,6 +18,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..ipam import IPAMError
 from ..labels import LabelArray, parse_label
 from ..policy.api import PolicyError
 from ..policy.jsonio import rules_from_json
@@ -303,6 +304,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(404, f"no route for {method} {path}")
         except PolicyError as exc:
             return self._error(400, str(exc))
+        except IPAMError as exc:
+            return self._error(409, str(exc))
         except (ValueError, KeyError) as exc:
             return self._error(400, f"bad request: {exc}")
 

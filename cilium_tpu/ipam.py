@@ -81,6 +81,10 @@ class HostScopeIPAM:
                 return True
             return False
 
+    def owner_of(self, ip: str) -> Optional[str]:
+        with self._lock:
+            return self._allocated.get(str(ipaddress.ip_address(ip)))
+
     def allocated(self) -> Dict[str, str]:
         with self._lock:
             return dict(self._allocated)

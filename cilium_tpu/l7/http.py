@@ -79,6 +79,20 @@ class HTTPRequest:
     headers: Optional[Dict[str, str]] = None
 
 
+def _request_line(r: HTTPRequest) -> str:
+    """The combined match string — ONE definition shared by the
+    batched encode() and the scalar check_one(), so the two tiers can
+    never frame a request differently."""
+    return f"{r.method}\x00{r.path}\x00{(r.host or '').lower()}"
+
+
+def _header_block(r: HTTPRequest) -> str:
+    hdrs = r.headers or {}
+    canon = "\x01".join(f"{k.lower()}: {v}"
+                        for k, v in sorted(hdrs.items()))
+    return "\x01" + canon + "\x01"
+
+
 class HTTPPolicyEngine:
     """One compiled HTTP rule set (one proxy redirect's policy)."""
 
@@ -135,20 +149,12 @@ class HTTPPolicyEngine:
         hot inputs device-resident."""
         if self._combined is None:          # allow-all: nothing to match
             return None, None
-        lines = [f"{r.method}\x00{r.path}\x00{(r.host or '').lower()}"
-                 for r in requests]
-        data = bucket_rows(bucket_cols(
-            encode_strings(lines, MAX_REQUEST_LINE)))
+        data = bucket_rows(bucket_cols(encode_strings(
+            [_request_line(r) for r in requests], MAX_REQUEST_LINE)))
         hdata = None
         if self._headers is not None:
-            blocks = []
-            for r in requests:
-                hdrs = r.headers or {}
-                canon = "\x01".join(f"{k.lower()}: {v}"
-                                    for k, v in sorted(hdrs.items()))
-                blocks.append("\x01" + canon + "\x01")
-            hdata = bucket_rows(bucket_cols(
-                encode_strings(blocks, MAX_HEADER_BLOCK)))
+            hdata = bucket_rows(bucket_cols(encode_strings(
+                [_header_block(r) for r in requests], MAX_HEADER_BLOCK)))
         return data, hdata
 
     def match_device(self, data, hdata):
@@ -188,17 +194,12 @@ class HTTPPolicyEngine:
             return True
         if self._scalar is None:
             return bool(self.check([request])[0])
-        r = request
-        line = f"{r.method}\x00{r.path}\x00{(r.host or '').lower()}" \
-            .encode()
+        line = _request_line(request).encode()
         if len(line) > MAX_REQUEST_LINE:
             return False  # overlong never matches (encode_strings -2)
         rule_hit = self._scalar.match(line)                # [R]
         if self._h_scalar is not None and rule_hit.any():
-            hdrs = r.headers or {}
-            canon = "\x01".join(f"{k.lower()}: {v}"
-                                for k, v in sorted(hdrs.items()))
-            block = ("\x01" + canon + "\x01").encode()
+            block = _header_block(request).encode()
             if len(block) > MAX_HEADER_BLOCK:
                 # overlong block poisons the HEADER patterns only
                 # (encode_strings -2 row): rules with header

@@ -194,6 +194,24 @@ def test_endpoint_create_claims_ip_in_ipam(agent):
     assert d.ipam_release(ip)
 
 
+def test_endpoint_create_conflicting_ip_is_409(agent):
+    """Review regression: a second endpoint on an IP another live
+    endpoint holds must be rejected, not silently double-claimed."""
+    d, srv = agent
+    d.endpoint_create(911, ipv4="10.200.0.9", labels=["k8s:a=b"])
+    from cilium_tpu.ipam import IPAMError
+    with pytest.raises(IPAMError):
+        d.endpoint_create(912, ipv4="10.200.0.9", labels=["k8s:a=b"])
+    # and over REST it surfaces as 409, not a 500
+    c = Client(srv.base_url)
+    with pytest.raises(SystemExit) as exc:
+        c.put("/endpoint/913", {"ipv4": "10.200.0.9", "labels": []})
+    assert "409" in str(exc.value)
+    # deleting the holder frees the address for reuse
+    d.endpoint_delete(911)
+    d.endpoint_create(914, ipv4="10.200.0.9", labels=["k8s:a=b"])
+
+
 def test_pack_meta_lockstep():
     """The C++ packing used by vc_classify_batch must equal
     compiler/policy_tables.py pack_meta (like the vc_hash_mix
