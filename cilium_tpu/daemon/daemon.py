@@ -436,20 +436,29 @@ class Daemon:
                         f"{ipv4} already in use by {holder}")
                 # outside the pool, or a non-endpoint claim (docker
                 # flow) whose owner releases it — proceed
-        ep = Endpoint(endpoint_id, ipv4=ipv4,
-                      container_name=container_name,
-                      opts=self.config.opts.fork())
-        ep.table_slot = self.table_mgr.attach(endpoint_id)
-        self.endpoints.insert(ep)
-        ep.update_labels(self.identity_allocator,
-                         Labels.from_model(list(labels or [])))
-        self.datapath.set_endpoint_identity(ep.table_slot,
-                                            ep.security_identity)
-        IDENTITY_COUNT.set(len(self.identity_allocator))
-        if ipv4:
-            self.ipcache.upsert(ipv4, ep.security_identity,
-                                SOURCE_AGENT_LOCAL,
-                                metadata=f"endpoint:{endpoint_id}")
+        try:
+            ep = Endpoint(endpoint_id, ipv4=ipv4,
+                          container_name=container_name,
+                          opts=self.config.opts.fork())
+            ep.table_slot = self.table_mgr.attach(endpoint_id)
+            self.endpoints.insert(ep)
+            ep.update_labels(self.identity_allocator,
+                             Labels.from_model(list(labels or [])))
+            self.datapath.set_endpoint_identity(ep.table_slot,
+                                                ep.security_identity)
+            IDENTITY_COUNT.set(len(self.identity_allocator))
+            if ipv4:
+                self.ipcache.upsert(ipv4, ep.security_identity,
+                                    SOURCE_AGENT_LOCAL,
+                                    metadata=f"endpoint:{endpoint_id}")
+        except BaseException:
+            # failed create must not strand the IP claim on a ghost
+            # endpoint (the claim above succeeded, nothing else did)
+            if ipv4:
+                self.ipam.release_if_owner(ipv4,
+                                           f"endpoint:{endpoint_id}")
+            self.endpoints.remove(endpoint_id)
+            raise
         self.endpoints.queue_regeneration(endpoint_id)
         return ep
 

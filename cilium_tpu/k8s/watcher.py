@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 from ..identity import RESERVED_UNMANAGED
 from ..labels import LabelArray, Label, SOURCE_K8S
 from ..node import Node, NodeAddress
+from ..utils.serializer import FunctionQueue, no_retry
 from .policy import (NS_LABELS_BASE, POLICY_LABEL_NAME,
                      POLICY_LABEL_NAMESPACE, parse_cnp,
                      parse_network_policy)
@@ -66,6 +67,13 @@ class K8sWatcher:
         self._pod_ips: Dict[tuple, str] = {}
         self.events_processed = 0
         self.events_by_kind: Dict[str, int] = {}
+        # async dispatch state: one ordered FunctionQueue per resource
+        # kind + last applied resourceVersion per object (staleness
+        # dedup, pkg/versioned analog)
+        self._queues: Dict[str, FunctionQueue] = {}
+        self._resource_versions: Dict[tuple, str] = {}
+        self._apply_lock = threading.RLock()
+        self._stopped = False
 
     # ------------------------------------------------------------ policy
 
@@ -425,6 +433,92 @@ class K8sWatcher:
         for key, (svc, _port) in list(self._ingresses.items()):
             if key[0] == namespace and svc == svc_name:
                 self._program_ingress(key)
+
+    # ------------------------------------------------- async dispatch
+
+    _HANDLERS = {
+        "cnp": "on_cnp", "networkpolicy": "on_network_policy",
+        "service": "on_service", "endpoints": "on_endpoints",
+        "pod": "on_pod", "node": "on_node",
+        "namespace": "on_namespace", "ingress": "on_ingress",
+    }
+
+    _ACTIONS = {"add": "added", "added": "added",
+                "modify": "modified", "modified": "modified",
+                "delete": "deleted", "deleted": "deleted"}
+
+    def enqueue_event(self, kind: str, action: str, obj: Dict,
+                      retries: int = 0) -> bool:
+        """Informer-side entry: apply the event asynchronously, in
+        arrival order per resource kind, skipping stale duplicates.
+
+        Reference shape: each resource type gets its own
+        serializer.FunctionQueue (daemon/k8s_watcher.go's
+        serializer per informer) and events carrying an older-or-equal
+        resourceVersion than the last seen one for that object are
+        dropped (pkg/versioned's equality/staleness check).  Handler
+        APPLICATION is serialized by one re-entrant lock across kinds
+        — watcher-local state (_services/_endpoints/_ns_labels/...) is
+        shared, so per-kind queues give ordering + a non-blocking
+        informer thread, not concurrent mutation.  A handler that
+        still fails after `retries` attempts (spaced by a short
+        backoff) rolls its resourceVersion record back so the
+        informer's resync of the same object is NOT dropped as stale.
+        Returns False when the event was dropped as stale.
+        """
+        action = self._ACTIONS[action]          # KeyError on junk
+        handler = getattr(self, self._HANDLERS[kind])
+        meta = obj.get("metadata", {})
+        okey = (kind, meta.get("namespace", ""), meta.get("name", ""))
+        rv = meta.get("resourceVersion")
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("K8sWatcher is stopped")
+            prev = self._resource_versions.get(okey)
+            if rv is not None and action != "deleted":
+                if prev is not None and int(rv) <= int(prev):
+                    return False  # stale replay/duplicate
+                self._resource_versions[okey] = rv
+            if action == "deleted":
+                self._resource_versions.pop(okey, None)
+            fq = self._queues.get(kind)
+            if fq is None:
+                fq = self._queues[kind] = FunctionQueue(name=kind)
+
+        def wait(n: int) -> bool:
+            if n <= retries:
+                time.sleep(min(0.05 * n, 0.5))
+                return True
+            # giving up: un-record this rv so the apiserver's resync
+            # of the identical object can re-apply it
+            with self._lock:
+                if self._resource_versions.get(okey) == rv:
+                    if prev is None:
+                        self._resource_versions.pop(okey, None)
+                    else:
+                        self._resource_versions[okey] = prev
+            return False
+
+        def apply():
+            with self._apply_lock:
+                handler(action, obj)
+
+        fq.enqueue(apply, wait)
+        return True
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Barrier: every enqueued event fully applied."""
+        with self._lock:
+            queues = list(self._queues.values())
+        return all(fq.wait_idle(timeout) for fq in queues)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            queues = list(self._queues.values())
+            self._queues.clear()
+        for fq in queues:
+            fq.stop()
 
     # ---------------------------------------------------------- plumbing
 

@@ -1,0 +1,82 @@
+"""Ordered function execution queue.
+
+Reference: pkg/serializer/func_queue.go — the k8s watcher pushes every
+informer event through a FunctionQueue per resource type, so events
+apply in arrival order while the informer thread never blocks on the
+handler, and a failing handler can be retried with caller-controlled
+backoff (WaitFunc).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+# WaitFunc(n_retries) -> True to retry the failed function again
+WaitFunc = Callable[[int], bool]
+
+
+def no_retry(_n: int) -> bool:
+    return False
+
+
+class FunctionQueue:
+    """Executes enqueued functions one at a time, in order.
+
+    ``enqueue(f, wait_func)``: f runs on the worker thread; when it
+    raises, wait_func(n) decides whether to re-run (reference
+    semantics: WaitFunc returns false -> drop and move on).
+    """
+
+    def __init__(self, queue_size: int = 1024, name: str = "fq"):
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._idle = threading.Condition()
+        self._pending = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"serializer-{name}")
+        self._thread.start()
+
+    def enqueue(self, f: Callable[[], None],
+                wait_func: WaitFunc = no_retry) -> None:
+        if self._stop.is_set():
+            raise RuntimeError("FunctionQueue is stopped")
+        with self._idle:
+            self._pending += 1
+        self._q.put((f, wait_func))
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                f, wait = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            retries = 0
+            while not self._stop.is_set():
+                try:
+                    f()
+                    break
+                except Exception:  # noqa: BLE001 — handler errors are
+                    # the caller's to observe via wait_func
+                    retries += 1
+                    if not wait(retries):
+                        break
+            with self._idle:
+                self._pending -= 1
+                if self._pending == 0:
+                    self._idle.notify_all()
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Block until every enqueued function has finished (test and
+        shutdown barrier)."""
+        with self._idle:
+            return self._idle.wait_for(lambda: self._pending == 0,
+                                       timeout=timeout)
+
+    def stop(self, drain: bool = True,
+             timeout: float = 10.0) -> None:
+        if drain:
+            self.wait_idle(timeout)
+        self._stop.set()
+        self._thread.join(timeout=2.0)

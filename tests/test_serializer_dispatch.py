@@ -1,0 +1,189 @@
+"""FunctionQueue ordering/retry semantics + the k8s watcher's async,
+resourceVersion-deduped dispatch (pkg/serializer + pkg/versioned
+analogs, wired the way daemon/k8s_watcher.go wires serializers)."""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.daemon.daemon import DaemonConfig
+from cilium_tpu.k8s.watcher import K8sWatcher
+from cilium_tpu.utils.serializer import FunctionQueue, no_retry
+
+
+def test_function_queue_preserves_order():
+    fq = FunctionQueue()
+    out = []
+    for i in range(200):
+        fq.enqueue(lambda i=i: out.append(i))
+    assert fq.wait_idle(10)
+    assert out == list(range(200))
+    fq.stop()
+
+
+def test_function_queue_retries_then_gives_up():
+    fq = FunctionQueue()
+    calls = []
+
+    def fails():
+        calls.append(1)
+        raise RuntimeError("boom")
+
+    # retry twice, then drop; the queue keeps running afterwards
+    fq.enqueue(fails, lambda n: n <= 2)
+    done = []
+    fq.enqueue(lambda: done.append(1))
+    assert fq.wait_idle(10)
+    assert len(calls) == 3 and done == [1]
+    fq.stop()
+
+
+def test_function_queue_concurrent_producers_serialize():
+    fq = FunctionQueue()
+    active = []
+    overlap = []
+
+    def work(i):
+        active.append(i)
+        if len(active) > 1:
+            overlap.append(i)
+        time.sleep(0.001)
+        active.remove(i)
+
+    threads = [threading.Thread(
+        target=lambda s=s: [fq.enqueue(lambda i=i: work(i))
+                            for i in range(s * 50, s * 50 + 50)])
+        for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fq.wait_idle(20)
+    assert overlap == []  # never two handlers in flight
+    fq.stop()
+
+
+def test_function_queue_rejects_after_stop():
+    fq = FunctionQueue()
+    fq.stop()
+    with pytest.raises(RuntimeError):
+        fq.enqueue(lambda: None)
+
+
+# ------------------------------------------------- watcher dispatch
+
+def _svc(name, ip, port, rv):
+    return {"metadata": {"name": name, "namespace": "default",
+                         "resourceVersion": rv},
+            "spec": {"clusterIP": ip,
+                     "ports": [{"port": port, "protocol": "TCP"}]}}
+
+
+def test_watcher_enqueue_applies_in_order_and_dedups():
+    d = Daemon(config=DaemonConfig())
+    try:
+        w = K8sWatcher(d)
+        key = ("default", "s1")
+        assert w.enqueue_event("service", "add",
+                               _svc("s1", "10.254.0.9", 80, "5"))
+        # stale duplicate (same rv) and older rv are both dropped
+        assert not w.enqueue_event("service", "modify",
+                                   _svc("s1", "10.254.0.9", 81, "5"))
+        assert not w.enqueue_event("service", "modify",
+                                   _svc("s1", "10.254.0.9", 82, "3"))
+        # newer rv applies
+        assert w.enqueue_event("service", "modify",
+                               _svc("s1", "10.254.0.9", 90, "6"))
+        assert w.wait_idle(10)
+        # the watcher applied exactly the two fresh events, in order
+        assert w.events_by_kind.get("service") == 2
+        assert w._services[key]["ports"][0]["port"] == 90
+        # delete APPLIES (both action spellings normalize) and clears
+        # the version record so a re-add with any rv applies
+        assert w.enqueue_event(
+            "service", "delete",
+            _svc("s1", "10.254.0.9", 90, "7"))
+        assert w.wait_idle(10)
+        assert key not in w._services  # delete really removed it
+        assert w.enqueue_event("service", "added",
+                               _svc("s1", "10.254.0.9", 80, "1"))
+        assert w.wait_idle(10)
+        assert key in w._services
+        w.stop()
+    finally:
+        d.shutdown()
+
+
+def test_watcher_enqueue_never_blocks_on_slow_handler():
+    d = Daemon(config=DaemonConfig())
+    try:
+        w = K8sWatcher(d)
+        orig = w.on_namespace
+        w.on_namespace = lambda a, o: (time.sleep(0.4), orig(a, o))
+        t0 = time.time()
+        w.enqueue_event("namespace", "add", {
+            "metadata": {"name": "slowns", "resourceVersion": "1"},
+            "labels": {}})
+        w.enqueue_event("service", "add",
+                        _svc("fast", "10.254.0.10", 80, "1"))
+        # the informer-side thread returns immediately; application
+        # happens behind the queues
+        assert time.time() - t0 < 0.2, "enqueue blocked on handler"
+        assert w.wait_idle(10)
+        w.stop()
+    finally:
+        d.shutdown()
+
+
+def test_watcher_failed_handler_unblocks_resync():
+    """A handler that exhausts its retries must roll back the
+    resourceVersion record so the apiserver's identical resync is not
+    dropped as stale."""
+    d = Daemon(config=DaemonConfig())
+    try:
+        w = K8sWatcher(d)
+        boom = {"n": 2}
+        orig = w.on_service
+
+        def flaky(a, o):
+            if boom["n"] > 0:
+                boom["n"] -= 1
+                raise RuntimeError("transient")
+            orig(a, o)
+
+        w.on_service = flaky
+        # no retries: first delivery fails and is dropped...
+        assert w.enqueue_event("service", "add",
+                               _svc("s2", "10.254.0.11", 80, "9"))
+        assert w.wait_idle(10)
+        assert ("default", "s2") not in w._services
+        # ...but the resync with the SAME rv must now apply
+        assert w.enqueue_event("service", "add",
+                               _svc("s2", "10.254.0.11", 80, "9"))
+        assert w.wait_idle(10)
+        assert not boom["n"]  # second failure consumed
+        assert w.enqueue_event("service", "add",
+                               _svc("s2", "10.254.0.11", 80, "9"))
+        assert w.wait_idle(10)
+        assert ("default", "s2") in w._services
+        w.stop()
+    finally:
+        d.shutdown()
+
+
+def test_watcher_rejects_events_after_stop():
+    d = Daemon(config=DaemonConfig())
+    try:
+        w = K8sWatcher(d)
+        w.enqueue_event("service", "add",
+                        _svc("s3", "10.254.0.12", 80, "1"))
+        assert w.wait_idle(10)
+        w.stop()
+        with pytest.raises(RuntimeError):
+            w.enqueue_event("service", "add",
+                            _svc("s4", "10.254.0.13", 80, "1"))
+        assert not w._queues  # no leaked fresh queue
+    finally:
+        d.shutdown()
