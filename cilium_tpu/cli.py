@@ -379,6 +379,52 @@ def cmd_cni(c: Client, args) -> int:
     return cni.main()
 
 
+def cmd_debuginfo(c: Client, args) -> int:
+    """cilium debuginfo (cilium/cmd/debuginfo.go): one aggregate
+    snapshot of agent state."""
+    _print_json(c.get("/debuginfo"))
+    return 0
+
+
+def cmd_kvstore(c: Client, args) -> int:
+    """cilium kvstore get/set/delete (cilium/cmd/kvstore_*.go),
+    routed through the agent's kvstore connection."""
+    from urllib.parse import quote
+    key = quote(args.key, safe="/")  # spaces/?/# must not split the URL
+    if args.kvstore_cmd == "get":
+        suffix = "?prefix=true" if args.recursive else ""
+        _print_json(c.get(f"/kvstore/{key}{suffix}"))
+    elif args.kvstore_cmd == "set":
+        _print_json(c.put(f"/kvstore/{key}", {"value": args.value}))
+    elif args.kvstore_cmd == "delete":
+        suffix = "?prefix=true" if args.recursive else ""
+        _print_json(c.request("DELETE", f"/kvstore/{key}{suffix}"))
+    return 0
+
+
+def cmd_cleanup(c: Client, args) -> int:
+    """cilium cleanup (cilium/cmd/cleanup.go): remove persisted agent
+    state (endpoint checkpoints) from the state directory.  Local
+    operation; requires -f like the reference."""
+    import os
+    import shutil
+    if not args.force:
+        print("cleanup removes all persisted endpoint state; "
+              "re-run with -f/--force to proceed")
+        return 1
+    state = args.state_dir
+    removed = 0
+    if os.path.isdir(state):
+        for fname in sorted(os.listdir(state)):
+            if fname.startswith("ep_") and fname.endswith(".json"):
+                os.unlink(os.path.join(state, fname))
+                removed += 1
+        if args.all:
+            shutil.rmtree(state, ignore_errors=True)
+    print(f"removed {removed} endpoint checkpoint(s) from {state}")
+    return 0
+
+
 def cmd_docker_plugin(c: Client, args) -> int:
     from . import docker_plugin
     return docker_plugin.main(["--api", c.base_url,
@@ -533,6 +579,26 @@ def build_parser() -> argparse.ArgumentParser:
                         help="serve the docker libnetwork remote driver")
     dp.add_argument("--listen-port", type=int, default=9235)
 
+    sub.add_parser("debuginfo", help="aggregate agent state snapshot")
+
+    kvp = sub.add_parser("kvstore", help="kvstore access via the agent")
+    kv_sub = kvp.add_subparsers(dest="kvstore_cmd", required=True)
+    g = kv_sub.add_parser("get")
+    g.add_argument("key")
+    g.add_argument("--recursive", action="store_true")
+    s = kv_sub.add_parser("set")
+    s.add_argument("key")
+    s.add_argument("value")
+    de = kv_sub.add_parser("delete")
+    de.add_argument("key")
+    de.add_argument("--recursive", action="store_true")
+
+    cl = sub.add_parser("cleanup", help="remove persisted agent state")
+    cl.add_argument("-f", "--force", action="store_true")
+    cl.add_argument("--all", action="store_true",
+                    help="remove the whole state dir")
+    cl.add_argument("--state-dir", default="/var/run/cilium_tpu")
+
     ag = sub.add_parser("agent", help="run the agent")
     ag.add_argument("--api-port", type=int, default=9234)
     ag.add_argument("--kvstore", default="none",
@@ -551,6 +617,8 @@ COMMANDS = {
     "config": cmd_config, "metrics": cmd_metrics,
     "bugtool": cmd_bugtool, "cni": cmd_cni,
     "docker-plugin": cmd_docker_plugin,
+    "debuginfo": cmd_debuginfo, "kvstore": cmd_kvstore,
+    "cleanup": cmd_cleanup,
     "migrate-state": cmd_migrate_state,
     "node": cmd_node, "map": cmd_map, "version": cmd_version,
 }

@@ -452,12 +452,18 @@ class Daemon:
                                     SOURCE_AGENT_LOCAL,
                                     metadata=f"endpoint:{endpoint_id}")
         except BaseException:
-            # failed create must not strand the IP claim on a ghost
-            # endpoint (the claim above succeeded, nothing else did)
+            # failed create must not strand ANY of its claims on a
+            # ghost endpoint: IP, ipcache entry, device-table slot,
+            # identity refcount (detach/release are no-ops for steps
+            # that never ran)
             if ipv4:
                 self.ipam.release_if_owner(ipv4,
                                            f"endpoint:{endpoint_id}")
-            self.endpoints.remove(endpoint_id)
+                self.ipcache.delete(ipv4, SOURCE_AGENT_LOCAL)
+            ghost = self.endpoints.remove(endpoint_id)
+            if ghost is not None and ghost.identity is not None:
+                self.identity_allocator.release(ghost.identity)
+            self.table_mgr.detach(endpoint_id)
             raise
         self.endpoints.queue_regeneration(endpoint_id)
         return ep

@@ -16,7 +16,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from ..ipam import IPAMError
 from ..labels import LabelArray, parse_label
@@ -101,6 +101,54 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, d.policy_resolve(
                     frm, to, dports=body.get("dports"),
                     verbose=bool(body.get("verbose"))))
+            if path == "/debuginfo" and method == "GET":
+                # cilium debuginfo (cilium/cmd/debuginfo.go): one
+                # aggregate snapshot for bug reports / support
+                return self._send(200, {
+                    "status": d.status(),
+                    "config": {"daemon": d.config.opts.dump(),
+                               "addressing": d.addressing()},
+                    "policy": {"revision": d.repo.revision,
+                               "rules": d.policy_get(None)},
+                    "endpoints": [ep.model()
+                                  for ep in d.endpoints.endpoints()],
+                    "services": _service_dump(d),
+                    "nodes": [n.to_model() for n in
+                              (d.node_registry.nodes()
+                               if d.node_registry
+                               else d.node_manager.nodes())],
+                    "ipam": {"v4-allocated": len(d.ipam),
+                             "v6-allocated":
+                             len(d.ipam6) if d.ipam6 is not None
+                             else 0},
+                })
+            m = re.fullmatch(r"/kvstore/(.+)", path)
+            if m:
+                # cilium kvstore get/set/delete (cilium/cmd/kvstore_*)
+                if d.kv is None:
+                    return self._error(503, "no kvstore attached")
+                key = unquote(m.group(1))
+                if method == "GET":
+                    if qs.get("prefix", ["0"])[0] in ("1", "true"):
+                        vals = d.kv.list_prefix(key)
+                        return self._send(200, {
+                            k: v.decode("utf-8", "replace")
+                            for k, v in vals.items()})
+                    val = d.kv.get(key)
+                    if val is None:
+                        return self._error(404, "key not found")
+                    return self._send(
+                        200, {key: val.decode("utf-8", "replace")})
+                if method == "PUT":
+                    body = json.loads(self._body() or b"{}")
+                    d.kv.set(key, str(body.get("value", "")).encode())
+                    return self._send(200, {"set": key})
+                if method == "DELETE":
+                    if qs.get("prefix", ["0"])[0] in ("1", "true"):
+                        d.kv.delete_prefix(key)
+                    else:
+                        d.kv.delete(key)
+                    return self._send(200, {"deleted": key})
             if path == "/ipam" and method == "POST":
                 # daemon/ipam.go AllocateIP analog
                 body = json.loads(self._body() or b"{}")

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 from ..identity import RESERVED_UNMANAGED
 from ..labels import LabelArray, Label, SOURCE_K8S
 from ..node import Node, NodeAddress
-from ..utils.serializer import FunctionQueue, no_retry
+from ..utils.serializer import FunctionQueue
 from .policy import (NS_LABELS_BASE, POLICY_LABEL_NAME,
                      POLICY_LABEL_NAMESPACE, parse_cnp,
                      parse_network_policy)
@@ -470,40 +470,54 @@ class K8sWatcher:
         handler = getattr(self, self._HANDLERS[kind])
         meta = obj.get("metadata", {})
         okey = (kind, meta.get("namespace", ""), meta.get("name", ""))
+        # k8s declares resourceVersions opaque; only decimal ones can
+        # be ordered — anything else bypasses dedup instead of killing
+        # the informer thread
         rv = meta.get("resourceVersion")
+        rv_num = int(rv) if isinstance(rv, str) and rv.isdigit() \
+            else None
         with self._lock:
             if self._stopped:
                 raise RuntimeError("K8sWatcher is stopped")
             prev = self._resource_versions.get(okey)
-            if rv is not None and action != "deleted":
-                if prev is not None and int(rv) <= int(prev):
+            if rv_num is not None and action != "deleted":
+                if prev is not None and rv_num <= prev:
                     return False  # stale replay/duplicate
-                self._resource_versions[okey] = rv
+                self._resource_versions[okey] = rv_num
             if action == "deleted":
                 self._resource_versions.pop(okey, None)
             fq = self._queues.get(kind)
             if fq is None:
                 fq = self._queues[kind] = FunctionQueue(name=kind)
 
-        def wait(n: int) -> bool:
-            if n <= retries:
-                time.sleep(min(0.05 * n, 0.5))
-                return True
-            # giving up: un-record this rv so the apiserver's resync
-            # of the identical object can re-apply it
+        def rollback_rv():
+            # un-record this rv so the apiserver's resync of the
+            # identical object is not dropped as stale
             with self._lock:
-                if self._resource_versions.get(okey) == rv:
+                if self._resource_versions.get(okey) == rv_num:
                     if prev is None:
                         self._resource_versions.pop(okey, None)
                     else:
                         self._resource_versions[okey] = prev
+
+        def wait(n: int) -> bool:
+            if n <= retries:
+                time.sleep(min(0.05 * n, 0.5))
+                return True
+            rollback_rv()  # handler gave up
             return False
 
         def apply():
             with self._apply_lock:
                 handler(action, obj)
 
-        fq.enqueue(apply, wait)
+        try:
+            fq.enqueue(apply, wait)
+        except RuntimeError:
+            # lost the race with stop(): the event will never apply,
+            # so its rv must not poison a later restart's dedup
+            rollback_rv()
+            raise
         return True
 
     def wait_idle(self, timeout: float = 10.0) -> bool:

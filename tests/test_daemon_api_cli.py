@@ -676,3 +676,65 @@ def test_regenerate_refused_state_returns_409():
         assert exc.value.code == 409
     finally:
         d.shutdown()
+
+
+def test_cli_debuginfo_kvstore_cleanup(capsys, tmp_path):
+    """cilium debuginfo / kvstore get|set|delete / cleanup analogs
+    (cilium/cmd/{debuginfo,kvstore_*,cleanup}.go)."""
+    from cilium_tpu.cli import main
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.kvstore.memory import InMemoryBackend, MemStore
+    state = str(tmp_path / "state")
+    d = Daemon(config=DaemonConfig(state_dir=state),
+               kvstore_backend=InMemoryBackend(MemStore()))
+    srv = APIServer(d).start()
+    try:
+        d.endpoint_create(21, ipv4="10.200.0.21", labels=["k8s:x=y"])
+        d.wait_for_quiesce(10)
+        # debuginfo aggregates everything
+        assert main(["--api", srv.base_url, "debuginfo"]) == 0
+        out = capsys.readouterr().out
+        assert "status" in out and "endpoints" in out
+        assert "10.200.0.21" in out
+        # kvstore set -> get -> recursive get -> delete
+        assert main(["--api", srv.base_url, "kvstore", "set",
+                     "test/alpha", "one"]) == 0
+        capsys.readouterr()
+        assert main(["--api", srv.base_url, "kvstore", "get",
+                     "test/alpha"]) == 0
+        assert "one" in capsys.readouterr().out
+        assert main(["--api", srv.base_url, "kvstore", "get",
+                     "test", "--recursive"]) == 0
+        assert "alpha" in capsys.readouterr().out
+        assert main(["--api", srv.base_url, "kvstore", "delete",
+                     "test/alpha"]) == 0
+        capsys.readouterr()
+        with pytest.raises(SystemExit):
+            main(["--api", srv.base_url, "kvstore", "get",
+                  "test/alpha"])
+        # cleanup: refuses without -f, then removes checkpoints
+        # (endpoint 21's own checkpoint plus this synthetic one)
+        import os
+        os.makedirs(state, exist_ok=True)
+        open(os.path.join(state, "ep_99.json"), "w").write("{}")
+        assert main(["cleanup", "--state-dir", state]) == 1
+        capsys.readouterr()
+        assert main(["cleanup", "-f", "--state-dir", state]) == 0
+        assert "endpoint checkpoint(s)" in capsys.readouterr().out
+        assert not os.path.exists(os.path.join(state, "ep_99.json"))
+        assert not os.path.exists(os.path.join(state, "ep_21.json"))
+    finally:
+        d.shutdown()
+
+
+def test_kvstore_routes_503_without_backend():
+    from cilium_tpu.daemon.rest import APIServer
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    try:
+        c = Client(srv.base_url)
+        with pytest.raises(SystemExit) as exc:
+            c.get("/kvstore/some/key")
+        assert "503" in str(exc.value)
+    finally:
+        d.shutdown()

@@ -10,7 +10,7 @@ import pytest
 from cilium_tpu.daemon import Daemon
 from cilium_tpu.daemon.daemon import DaemonConfig
 from cilium_tpu.k8s.watcher import K8sWatcher
-from cilium_tpu.utils.serializer import FunctionQueue, no_retry
+from cilium_tpu.utils.serializer import FunctionQueue
 
 
 def test_function_queue_preserves_order():
@@ -185,5 +185,48 @@ def test_watcher_rejects_events_after_stop():
             w.enqueue_event("service", "add",
                             _svc("s4", "10.254.0.13", 80, "1"))
         assert not w._queues  # no leaked fresh queue
+    finally:
+        d.shutdown()
+
+
+def test_watcher_opaque_resource_versions_bypass_dedup():
+    """Non-decimal resourceVersions (k8s declares them opaque) must
+    not crash the informer thread; they simply skip dedup."""
+    d = Daemon(config=DaemonConfig())
+    try:
+        w = K8sWatcher(d)
+        ev = _svc("sx", "10.254.0.20", 80, "v12-not-a-number")
+        assert w.enqueue_event("service", "add", ev)
+        assert w.enqueue_event("service", "modify", ev)  # no dedup
+        assert w.wait_idle(10)
+        assert w.events_by_kind.get("service") == 2
+        w.stop()
+    finally:
+        d.shutdown()
+
+
+def test_endpoint_create_rollback_frees_slot_and_identity():
+    """Review regression: a failed create must not leak the device
+    table slot or the identity refcount."""
+    d = Daemon(config=DaemonConfig())
+    try:
+        idents_before = len(d.identity_allocator)
+        slots_before = len(d.table_mgr._slot_of)
+        orig = d.datapath.set_endpoint_identity
+        d.datapath.set_endpoint_identity = \
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom"))
+        with pytest.raises(RuntimeError):
+            d.endpoint_create(888, ipv4="10.200.0.88",
+                              labels=["k8s:app=ghost"])
+        d.datapath.set_endpoint_identity = orig
+        assert d.endpoints.lookup(888) is None
+        assert "10.200.0.88" not in d.ipam.allocated()
+        assert d.ipcache.lookup_by_ip("10.200.0.88") is None
+        assert len(d.identity_allocator) == idents_before
+        assert len(d.table_mgr._slot_of) == slots_before
+        # the id and IP are fully reusable
+        d.endpoint_create(888, ipv4="10.200.0.88",
+                          labels=["k8s:app=ghost"])
+        assert d.wait_for_quiesce(10)
     finally:
         d.shutdown()
