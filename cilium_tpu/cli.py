@@ -316,7 +316,9 @@ def cmd_monitor(c: Client, args) -> int:
                 f"/monitor?n=200&drops={'true' if args.drops else 'false'}")
             for e in events:
                 key = (e["timestamp"], e["code"], e["endpoint"],
-                       e["identity"], e["dport"], e["proto"], e["length"])
+                       e["identity"], e["dport"], e["proto"],
+                       e["length"], e.get("kind", ""),
+                       e.get("note", ""))
                 if key not in seen:
                     seen.add(key)
                     print(e["message"])

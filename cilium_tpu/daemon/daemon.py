@@ -95,6 +95,11 @@ class Daemon:
         self.host_ipv6 = self.ipam6.router_ip() \
             if self.ipam6 is not None else ""
 
+        # L7 access-log records join the monitor stream
+        # (LogRecordNotify analog: pkg/proxy/logger -> monitor)
+        self.proxy.access_log.subscribers.append(self.monitor.notify_l7)
+        self.monitor.notify_agent("agent-start", node_name)
+
         # the node manager must exist before the registry: registry
         # construction synchronously replays pre-existing nodes into
         # _on_node_update, which programs it
@@ -146,7 +151,10 @@ class Daemon:
 
         # endpoint regeneration pipeline (daemon.go:1133 builders)
         self.endpoints = EndpointManager(
-            regenerate_fn=self._regenerate_endpoint, builders=builders)
+            regenerate_fn=self._regenerate_endpoint, builders=builders,
+            on_outcome=lambda ep_id, ok: self.monitor.notify_agent(
+                "endpoint-regenerate-success" if ok
+                else "endpoint-regenerate-failure", f"id={ep_id}"))
         self._regen_trigger = Trigger(
             lambda reasons: self.endpoints.regenerate_all(
                 ",".join(reasons) or "policy-update"),
@@ -230,6 +238,8 @@ class Daemon:
             rev = self.repo.add_list(list(rules))
         POLICY_COUNT.set(len(self.repo))
         POLICY_REVISION.set(rev)
+        self.monitor.notify_agent("policy-updated",
+                                  f"revision={rev} rules={len(rules)}")
         self.trigger_policy_updates("policy-add")
         return rev
 
@@ -245,6 +255,8 @@ class Daemon:
         POLICY_COUNT.set(len(self.repo))
         POLICY_REVISION.set(rev)
         if deleted:
+            self.monitor.notify_agent(
+                "policy-deleted", f"revision={rev} rules={deleted}")
             self.trigger_policy_updates("policy-delete")
         return rev, deleted
 
@@ -465,6 +477,8 @@ class Daemon:
                 self.identity_allocator.release(ghost.identity)
             self.table_mgr.detach(endpoint_id)
             raise
+        self.monitor.notify_agent("endpoint-created",
+                                  f"id={endpoint_id} ipv4={ipv4}")
         self.endpoints.queue_regeneration(endpoint_id)
         return ep
 
@@ -496,6 +510,8 @@ class Daemon:
                 pass
         self.table_mgr.detach(endpoint_id)
         self.datapath.refresh_policy()
+        self.monitor.notify_agent("endpoint-deleted",
+                                  f"id={endpoint_id}")
         return True
 
     def endpoint_update_labels(self, endpoint_id: int,

@@ -20,6 +20,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 from ..ipam import IPAMError
 from ..labels import LabelArray, parse_label
+from ..monitor import _monitor_event_dict
 from ..policy.api import PolicyError
 from ..policy.jsonio import rules_from_json
 from .daemon import Daemon
@@ -314,13 +315,13 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/monitor" and method == "GET":
                 n = int(qs.get("n", ["100"])[0])
                 drops = qs.get("drops", ["false"])[0] == "true"
-                events = d.monitor.tail(n, drops_only=drops)
-                return self._send(200, [
-                    {"timestamp": e.timestamp, "code": e.code,
-                     "endpoint": e.endpoint, "identity": e.identity,
-                     "dport": e.dport, "proto": e.proto,
-                     "length": e.length, "message": e.describe()}
-                    for e in events])
+                # agent | l7 | datapath (named sentinel for kind "")
+                kind = qs.get("kind", [None])[0]
+                if kind == "datapath":
+                    kind = ""
+                events = d.monitor.tail(n, drops_only=drops, kind=kind)
+                return self._send(200, [_monitor_event_dict(e)
+                                        for e in events])
             if path == "/monitor/stats" and method == "GET":
                 return self._send(200, d.monitor.stats())
             if path == "/node" and method == "GET":

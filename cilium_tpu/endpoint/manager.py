@@ -24,11 +24,15 @@ class EndpointManager:
     """Registry by id / container name + the build queue."""
 
     def __init__(self, regenerate_fn: Optional[Callable[[Endpoint], None]]
-                 = None, builders: int = MIN_BUILDERS):
+                 = None, builders: int = MIN_BUILDERS,
+                 on_outcome: Optional[Callable[[int, bool], None]] = None):
         self._lock = threading.RLock()
         self._by_id: Dict[int, Endpoint] = {}
         self._by_container: Dict[str, Endpoint] = {}
         self.regenerate_fn = regenerate_fn
+        # (endpoint_id, ok) observer — the daemon feeds the monitor's
+        # AgentNotify regenerate success/fail events from here
+        self.on_outcome = on_outcome
         # build queue state (buildqueue semantics)
         self._queue: "queue.Queue[int]" = queue.Queue()
         self._queued: set = set()     # ids with a pending queue slot
@@ -163,3 +167,8 @@ class EndpointManager:
             ENDPOINT_REGENERATION_TIME.observe(time.perf_counter() - t0)
             ep.set_state(EndpointState.READY if ok
                          else EndpointState.NOT_READY, "build done")
+            if self.on_outcome is not None:
+                try:
+                    self.on_outcome(ep_id, ok)
+                except Exception:  # noqa: BLE001 — observer must not
+                    pass           # poison the build pipeline

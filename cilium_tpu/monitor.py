@@ -24,7 +24,14 @@ from .utils.metrics import DROP_COUNT, FORWARD_COUNT
 
 @dataclass(frozen=True)
 class MonitorEvent:
-    """One decoded sample (DropNotify/TraceNotify analog)."""
+    """One decoded sample.
+
+    kind "" = datapath DropNotify/TraceNotify analog (code/endpoint/
+    packet fields populated); kind "agent" = AgentNotify analog
+    (pkg/monitor/agent events: policy updates, endpoint lifecycle);
+    kind "l7" = LogRecordNotify analog (proxy access-log records in
+    the monitor stream) — the same three families `cilium monitor`
+    prints in the reference."""
 
     timestamp: float
     code: int            # trace point (>=0) or drop reason (<0)
@@ -33,12 +40,18 @@ class MonitorEvent:
     dport: int
     proto: int
     length: int
+    kind: str = ""       # "" | "agent" | "l7"
+    note: str = ""
 
     @property
     def is_drop(self) -> bool:
-        return self.code < 0
+        return self.kind == "" and self.code < 0
 
     def describe(self) -> str:
+        if self.kind == "agent":
+            return f"AGENT {self.note}"
+        if self.kind == "l7":
+            return f"L7 {self.note}"
         name = DROP_NAMES.get(self.code) or TRACE_NAMES.get(self.code) or \
             f"code {self.code}"
         kind = "DROP" if self.is_drop else "TRACE"
@@ -60,6 +73,8 @@ class MonitorHub:
         self._bytes: Dict[int, int] = {}
         self._subscribers: List[Callable[[MonitorEvent], None]] = []
         self.lost = 0  # samples not ringed (perf-ring lost-events analog)
+        # AgentNotify / LogRecordNotify counters, keyed by event name
+        self._notify_counts: Dict[str, int] = {}
 
     # ------------------------------------------------------------ ingest
 
@@ -108,6 +123,39 @@ class MonitorHub:
             for ev in samples:
                 fn(ev)
 
+    def _push(self, ev: MonitorEvent, counter: str) -> None:
+        with self._lock:
+            self._notify_counts[counter] = \
+                self._notify_counts.get(counter, 0) + 1
+            self._ring.append(ev)
+            if len(self._ring) > self.ring_capacity:
+                self._ring = self._ring[-self.ring_capacity:]
+            subs = list(self._subscribers)
+        for fn in subs:
+            fn(ev)
+
+    def notify_agent(self, event: str, note: str = "") -> None:
+        """AgentNotify analog (pkg/monitor agent events: policy
+        updated/deleted, endpoint lifecycle, agent start)."""
+        self._push(MonitorEvent(
+            timestamp=time.time(), code=0, endpoint=0, identity=0,
+            dport=0, proto=0, length=0, kind="agent",
+            note=f"{event} {note}".strip()), f"agent:{event}")
+
+    def notify_l7(self, entry) -> None:
+        """LogRecordNotify analog: a proxy access-log record enters
+        the monitor stream (pkg/proxy/logger -> monitor)."""
+        info = " ".join(f"{k}={v}" for k, v in
+                        sorted((entry.info or {}).items()))
+        self._push(MonitorEvent(
+            timestamp=entry.timestamp, code=0, endpoint=0,
+            identity=entry.src_identity, dport=0, proto=0, length=0,
+            kind="l7",
+            note=f"{entry.l7_protocol} {entry.verdict} "
+                 f"src={entry.src_identity} dst={entry.dst_identity} "
+                 f"{info}".strip()),
+            f"l7:{entry.l7_protocol}:{entry.verdict}")
+
     # --------------------------------------------------------- consumers
 
     def subscribe(self, fn: Callable[[MonitorEvent], None]) -> Callable:
@@ -122,16 +170,19 @@ class MonitorHub:
                     self._subscribers.remove(fn)
         return unsubscribe
 
-    def tail(self, n: int = 100,
-             drops_only: bool = False) -> List[MonitorEvent]:
+    def tail(self, n: int = 100, drops_only: bool = False,
+             kind: Optional[str] = None) -> List[MonitorEvent]:
         with self._lock:
             ring = list(self._ring)
         if drops_only:
             ring = [e for e in ring if e.is_drop]
+        if kind is not None:
+            ring = [e for e in ring if e.kind == kind]
         return ring[-n:]
 
     def stats(self) -> Dict[str, Dict]:
-        """metricsmap-style dump: per-code packet/byte totals."""
+        """metricsmap-style dump: per-code packet/byte totals, plus
+        agent/l7 notification counts."""
         with self._lock:
             out = {}
             for code, n in sorted(self._counts.items()):
@@ -139,6 +190,8 @@ class MonitorHub:
                     str(code)
                 out[name] = {"code": code, "packets": n,
                              "bytes": self._bytes.get(code, 0)}
+            for name, n in sorted(self._notify_counts.items()):
+                out[name] = {"events": n}
             return out
 
     def reset(self) -> None:
@@ -146,6 +199,7 @@ class MonitorHub:
             self._ring = []
             self._counts = {}
             self._bytes = {}
+            self._notify_counts = {}
             self.lost = 0
 
 
@@ -163,6 +217,7 @@ def _monitor_event_dict(ev: MonitorEvent) -> Dict:
     return {"timestamp": ev.timestamp, "code": ev.code,
             "endpoint": ev.endpoint, "identity": ev.identity,
             "dport": ev.dport, "proto": ev.proto, "length": ev.length,
+            "kind": ev.kind, "note": ev.note,
             "message": ev.describe()}
 
 

@@ -242,3 +242,87 @@ def test_daemon_structures_use_debug_locks(short_timeout):
         assert isinstance(d.proxy._lock, _DebugRMutex)
     finally:
         d.shutdown()
+
+
+def test_agent_and_l7_events_join_the_monitor_stream():
+    """AgentNotify + LogRecordNotify analogs: agent lifecycle and L7
+    access-log records appear in the same monitor stream as datapath
+    samples (pkg/monitor agent events + pkg/proxy/logger)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import time as _time
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.utils.option import DaemonConfig
+    from cilium_tpu.proxy import AccessLogEntry
+    d = Daemon(config=DaemonConfig())
+    try:
+        # agent-start announced at boot
+        agent_evs = d.monitor.tail(100, kind="agent")
+        assert any("agent-start" in e.note for e in agent_evs)
+        d.endpoint_create(61, ipv4="10.200.0.61", labels=["k8s:m=n"])
+        assert d.wait_for_quiesce(10)
+        agent_evs = d.monitor.tail(100, kind="agent")
+        notes = [e.note for e in agent_evs]
+        assert any("endpoint-created id=61" in n for n in notes)
+        assert any("endpoint-regenerate-success id=61" in n
+                   for n in notes)
+        # policy update + delete emit agent events
+        from cilium_tpu.policy.api import (EndpointSelector, IngressRule,
+                                           Rule)
+        from cilium_tpu.labels import LabelArray
+        d.policy_add([Rule(endpoint_selector=EndpointSelector.parse("m=n"),
+                           ingress=[IngressRule()],
+                           labels=LabelArray.parse("p=1"))])
+        d.policy_delete(LabelArray.parse("p=1"))
+        notes = [e.note for e in d.monitor.tail(100, kind="agent")]
+        assert any(n.startswith("policy-updated") for n in notes)
+        assert any(n.startswith("policy-deleted") for n in notes)
+        # an access-log record flows into the stream as an l7 event
+        d.proxy.access_log.log(AccessLogEntry(
+            timestamp=_time.time(), proxy_id="1:ingress:TCP:80",
+            l7_protocol="http", verdict="denied",
+            src_identity=1234, dst_identity=5678,
+            info={"method": "GET", "path": "/secret"}))
+        l7 = d.monitor.tail(10, kind="l7")
+        assert l7 and "denied" in l7[-1].note and \
+            l7[-1].identity == 1234
+        # stats aggregate the notification families
+        st = d.monitor.stats()
+        assert st.get("l7:http:denied", {}).get("events") == 1
+        assert "agent:endpoint-created" in st
+        # endpoint delete emits too
+        d.endpoint_delete(61)
+        notes = [e.note for e in d.monitor.tail(100, kind="agent")]
+        assert any("endpoint-deleted id=61" in n for n in notes)
+    finally:
+        d.shutdown()
+
+
+def test_monitor_rest_kind_filters():
+    """kind=agent/l7/datapath filter the REST stream; 'datapath' is
+    the named sentinel for packet samples (review regression)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from cilium_tpu.cli import Client
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.utils.option import DaemonConfig
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    try:
+        c = Client(srv.base_url)
+        # one datapath sample + the boot agent event are both present
+        d.monitor.ingest_batch(np.array([-130]), np.array([1]),
+                               np.array([2]), np.array([80]),
+                               np.array([6]), np.array([100]))
+        mixed = c.get("/monitor?n=50")
+        kinds = {e["kind"] for e in mixed}
+        assert "" in kinds and "agent" in kinds
+        only_dp = c.get("/monitor?n=50&kind=datapath")
+        assert only_dp and all(e["kind"] == "" for e in only_dp)
+        only_agent = c.get("/monitor?n=50&kind=agent")
+        assert only_agent and all(e["kind"] == "agent"
+                                  for e in only_agent)
+    finally:
+        d.shutdown()
