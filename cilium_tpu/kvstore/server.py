@@ -31,6 +31,7 @@ import threading
 import uuid
 from typing import Dict, Optional, Tuple
 
+from ..utils.netio import recv_exact as _recv_exact
 from .backend import Event, KVLockError, Lock, Watcher
 from .memory import InMemoryBackend, MemStore
 
@@ -72,17 +73,6 @@ def recv_frame(sock: socket.socket) -> Optional[dict]:
     return json.loads(body)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        try:
-            chunk = sock.recv(n - len(buf))
-        except OSError:
-            return None
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
 
 
 def _b64(value: bytes) -> str:

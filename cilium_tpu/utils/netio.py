@@ -1,0 +1,27 @@
+"""Shared socket byte-exact IO.
+
+One definition of the exact-read loop used by every TCP surface
+(kvstore transport, verdict service) — linear-time via a preallocated
+bytearray + recv_into, not O(n^2) bytes concatenation.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes; None on EOF or socket error."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except OSError:
+            return None
+        if r == 0:
+            return None
+        got += r
+    return bytes(buf)

@@ -1,0 +1,292 @@
+"""Network verdict service: remote peers stream packet-header batches,
+the TPU answers verdicts.
+
+The "daemon -> TPU verdict service RPC hop" of the TPU-native design
+(SURVEY.md §5 distributed backend, §2.8 scale-out, §7 phase 5): where
+the reference enforces per-packet in the kernel on every node, this
+framework lets any ingest point (another node's datapath, a proxy, a
+capture pipeline) ship header batches over the network to a TPU-backed
+classifier.  The reference has no direct equivalent — its closest shape
+is the proxy_port redirect into Envoy; here the redirect target is a
+batch RPC.
+
+Architecture per connection (two-tier ingest, reusing the native
+runtime):
+
+  reader thread --> C++ SPSC PacketRing --> dispatcher thread --> TPU
+   (socket recv,      (native/runtime.cc,     (drains up to
+    raw records        lock-free, SoA          max_batch records,
+    pushed as           drain)                 pads to a pow2 bucket,
+    received)                                  ONE device dispatch)
+
+Small frames from chatty clients coalesce in the ring, so the device
+sees large batches regardless of client write sizes; responses are
+returned per frame, in order (SPSC preserves FIFO).
+
+Wire protocol — 12-byte headers are big-endian; the record payload is
+the native PKT_HEADER_DTYPE layout (LITTLE-endian fields, 24B/record,
+ABI-checked against the C++ struct):
+  request : u32 0xC111A901 | u32 frame_id | u32 count |
+            count * 24B PKT_HEADER_DTYPE records
+  response: u32 0xC111A902 | u32 frame_id | u32 count |
+            count * i32 verdict (big-endian) |
+            count * i32 identity (big-endian)
+
+Batch padding: drained record counts round up to a power-of-two bucket
+(bounded jit cache).  Pad rows are copies of the first real record, so
+they cannot mint new conntrack keys — the duplicate row only re-touches
+the same flow's entry; results for pad rows are sliced off.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from .utils.netio import recv_exact as _recv_exact
+
+MAGIC_REQ = 0xC111A901
+MAGIC_RESP = 0xC111A902
+MAX_COUNT = 1 << 20
+
+
+class VerdictServiceError(RuntimeError):
+    pass
+
+
+def _bucket(n: int, min_rows: int = 16) -> int:
+    rows = min_rows
+    while rows < n:
+        rows *= 2
+    return rows
+
+
+class VerdictService:
+    """Serves a Datapath over TCP (one ring + dispatcher per
+    connection; the daemon's device lock serializes actual device
+    dispatch)."""
+
+    def __init__(self, datapath, host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int = 1 << 15):
+        from .native import load
+        load()  # the ring is mandatory here; fail at construction
+        self.datapath = datapath
+        self.max_batch = max_batch
+        self.frames_served = 0
+        self.batches_dispatched = 0
+        self._stats_lock = threading.Lock()  # one dispatcher per conn
+        svc = self
+
+        class _Conn(socketserver.BaseRequestHandler):
+            def handle(self):
+                svc._serve_conn(self.request)
+
+        class _TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _TCP((host, port), _Conn)
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------- per-connection
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        from .native import PKT_HEADER_DTYPE, PacketRing
+        ring = PacketRing(capacity=1 << 16)
+        frames: "deque[Tuple[int, int]]" = deque()  # (frame_id, count)
+        frames_lock = threading.Lock()
+        eof = threading.Event()
+        wake = threading.Event()
+
+        def dispatcher():
+            try:
+                while True:
+                    with frames_lock:
+                        have = len(frames) > 0
+                    if not have:
+                        if eof.is_set():
+                            return
+                        wake.wait(0.05)
+                        wake.clear()
+                        continue
+                    soa, n = ring.pop_batch(self.max_batch)
+                    if n == 0:
+                        wake.wait(0.005)
+                        wake.clear()
+                        continue
+                    verdicts, idents = self._classify(soa, n)
+                    # answer every complete frame covered by this drain
+                    off = 0
+                    out = []
+                    with frames_lock:
+                        while frames and off + frames[0][1] <= n:
+                            fid, cnt = frames.popleft()
+                            out.append((fid, verdicts[off:off + cnt],
+                                        idents[off:off + cnt]))
+                            off += cnt
+                        if off != n:
+                            # drain split a frame: its tail is still in
+                            # the ring; stash the head
+                            fid, cnt = frames.popleft()
+                            frames.appendleft((fid, cnt - (n - off)))
+                            out.append((fid, verdicts[off:n],
+                                        idents[off:n], True))
+                    for item in out:
+                        self._send_resp(sock, item, partials)
+            except OSError:
+                pass
+            except Exception:  # noqa: BLE001 — e.g. "no policy
+                # loaded" mid-recompile: a dead dispatcher must not
+                # leave the client hanging until its timeout
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+        # partial-frame reassembly buffer: frame_id -> [verdicts, ids]
+        partials = {}
+
+        t = threading.Thread(target=dispatcher, daemon=True,
+                             name="verdict-dispatch")
+        t.start()
+        try:
+            while True:
+                head = _recv_exact(sock, 12)
+                if head is None:
+                    break
+                magic, frame_id, count = struct.unpack(">III", head)
+                if magic != MAGIC_REQ or count == 0 or count > MAX_COUNT:
+                    break  # protocol error: drop the connection
+                raw = _recv_exact(sock, count * PKT_HEADER_DTYPE.itemsize)
+                if raw is None:
+                    break
+                recs = np.frombuffer(raw, PKT_HEADER_DTYPE)
+                with frames_lock:
+                    frames.append((frame_id, count))
+                pushed = 0
+                while pushed < count:
+                    got = ring.push(recs[pushed:], drop_on_full=False)
+                    pushed += got
+                    wake.set()
+                    if not got:          # ring full: give the
+                        time.sleep(0.001)  # dispatcher room to drain
+        finally:
+            eof.set()
+            wake.set()
+            t.join(timeout=5)
+            if not t.is_alive():
+                ring.close()
+            # else: dispatcher still running (long compile / blocked
+            # send) — the ring is freed by its __del__ once the thread
+            # exits; destroying it now would be a native use-after-free
+
+    def _send_resp(self, sock, item, partials) -> None:
+        if len(item) == 4:            # head of a split frame: buffer it
+            fid, v, i, _partial = item
+            acc = partials.setdefault(fid, [[], []])
+            acc[0].append(v)
+            acc[1].append(i)
+            return
+        fid, v, i = item
+        if fid in partials:
+            acc = partials.pop(fid)
+            v = np.concatenate(acc[0] + [v])
+            i = np.concatenate(acc[1] + [i])
+        payload = struct.pack(">III", MAGIC_RESP, fid, len(v)) + \
+            v.astype(">i4").tobytes() + i.astype(">i4").tobytes()
+        with self._stats_lock:    # before send: a synchronous client
+            self.frames_served += 1  # may read the counter on response
+        sock.sendall(payload)
+
+    # -------------------------------------------------------- classify
+
+    def _classify(self, soa, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One device dispatch for n drained records (padded to a
+        power-of-two bucket; pad rows duplicate row 0 so no new
+        conntrack keys appear)."""
+        from .datapath.engine import make_full_batch
+        rows = _bucket(n)
+
+        def pad(a):
+            out = np.empty(rows, np.int32)
+            out[:n] = a[:n]
+            out[n:] = a[0]
+            return out
+
+        batch = make_full_batch(
+            endpoint=pad(soa["endpoint"]), saddr=pad(soa["saddr"]),
+            daddr=pad(soa["daddr"]), sport=pad(soa["sport"]),
+            dport=pad(soa["dport"]), proto=pad(soa["proto"]),
+            direction=pad(soa["direction"]),
+            tcp_flags=pad(soa["tcp_flags"]),
+            is_fragment=pad(soa["is_fragment"]),
+            length=pad(soa["length"]))
+        verdict, _event, identity, _nat = self.datapath.process(batch)
+        with self._stats_lock:
+            self.batches_dispatched += 1
+        return (np.asarray(verdict)[:n].astype(np.int32),
+                np.asarray(identity)[:n].astype(np.int32))
+
+    # --------------------------------------------------------- lifecycle
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self) -> "VerdictService":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True,
+                                        name="verdict-service")
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class VerdictClient:
+    """Blocking client: ship PKT_HEADER_DTYPE record batches, get
+    (verdicts, identities) back.  Pipelinable: frame ids correlate."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def classify(self, records: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        from .native import PKT_HEADER_DTYPE
+        recs = np.ascontiguousarray(records, PKT_HEADER_DTYPE)
+        with self._lock:
+            fid = self._next_id
+            self._next_id += 1
+            self._sock.sendall(struct.pack(">III", MAGIC_REQ, fid,
+                                           len(recs)) + recs.tobytes())
+            head = _recv_exact(self._sock, 12)
+            if head is None:
+                raise VerdictServiceError("connection closed")
+            magic, rid, count = struct.unpack(">III", head)
+            if magic != MAGIC_RESP or rid != fid:
+                raise VerdictServiceError(
+                    f"bad response (magic={magic:#x} id={rid})")
+            body = _recv_exact(self._sock, count * 8)
+            if body is None:
+                raise VerdictServiceError("truncated response")
+            v = np.frombuffer(body[:count * 4], ">i4").astype(np.int32)
+            i = np.frombuffer(body[count * 4:], ">i4").astype(np.int32)
+            return v, i
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

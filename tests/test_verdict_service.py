@@ -1,0 +1,181 @@
+"""Verdict service: remote header batches -> TPU verdicts over TCP.
+
+The daemon->TPU verdict-service RPC hop (SURVEY §5/§2.8/§7): clients
+ship PKT_HEADER_DTYPE record batches; the service coalesces them
+through the C++ SPSC ring into device-sized dispatches and answers per
+frame, in order.
+"""
+
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.daemon.daemon import DaemonConfig
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.native import PKT_HEADER_DTYPE
+from cilium_tpu.policy.api import (EndpointSelector, IngressRule,
+                                   PortProtocol, PortRule, Rule)
+from cilium_tpu.verdict_service import (VerdictClient, VerdictService,
+                                        VerdictServiceError)
+
+
+@pytest.fixture()
+def wired_daemon():
+    d = Daemon(config=DaemonConfig())
+    web = d.endpoint_create(1, ipv4="10.200.3.1",
+                            labels=["k8s:app=web"])
+    db = d.endpoint_create(2, ipv4="10.200.3.2", labels=["k8s:app=db"])
+    d.policy_add([Rule(
+        endpoint_selector=EndpointSelector.parse("app=db"),
+        ingress=[IngressRule(
+            from_endpoints=[EndpointSelector.parse("app=web")],
+            to_ports=[PortRule(ports=[
+                PortProtocol(port="5432", protocol="TCP")])])])])
+    assert d.wait_for_quiesce(30)
+    yield d, web, db
+    d.shutdown()
+
+
+def _records(db_slot, web_ip_u32, db_ip_u32, sports, dports):
+    n = len(sports)
+    recs = np.zeros(n, PKT_HEADER_DTYPE)
+    recs["endpoint"] = db_slot
+    recs["saddr"] = web_ip_u32
+    recs["daddr"] = db_ip_u32
+    recs["sport"] = sports
+    recs["dport"] = dports
+    recs["proto"] = 6
+    recs["direction"] = 0
+    recs["tcp_flags"] = 0x02
+    recs["length"] = 100
+    return recs
+
+
+def _ip_u32(ip):
+    from cilium_tpu.compiler.lpm import ipv4_to_u32
+    return ipv4_to_u32(ip)
+
+
+def test_remote_batch_verdicts_match_policy(wired_daemon):
+    d, web, db = wired_daemon
+    svc = VerdictService(d.datapath).start()
+    try:
+        client = VerdictClient("127.0.0.1", svc.port)
+        recs = _records(db.table_slot, _ip_u32(web.ipv4),
+                        _ip_u32(db.ipv4),
+                        sports=[41000, 41001, 41002],
+                        dports=[5432, 80, 22])
+        v, ids = client.classify(recs)
+        assert v[0] >= 0          # allowed port
+        assert v[1] < 0 and v[2] < 0
+        assert (ids == web.security_identity).all()
+        client.close()
+    finally:
+        svc.shutdown()
+
+
+def test_many_small_frames_coalesce_and_answer_in_order(wired_daemon):
+    d, web, db = wired_daemon
+    svc = VerdictService(d.datapath).start()
+    try:
+        client = VerdictClient("127.0.0.1", svc.port)
+        for k in range(30):
+            port = 5432 if k % 2 == 0 else 81
+            recs = _records(db.table_slot, _ip_u32(web.ipv4),
+                            _ip_u32(db.ipv4),
+                            sports=[42000 + k], dports=[port])
+            v, ids = client.classify(recs)
+            assert (v[0] >= 0) == (k % 2 == 0), (k, v)
+        assert svc.frames_served == 30
+        client.close()
+    finally:
+        svc.shutdown()
+
+
+def test_frame_larger_than_max_batch_splits_and_reassembles(
+        wired_daemon):
+    d, web, db = wired_daemon
+    # tiny device batches force the split/reassembly path
+    svc = VerdictService(d.datapath, max_batch=32).start()
+    try:
+        client = VerdictClient("127.0.0.1", svc.port)
+        n = 200
+        dports = np.where(np.arange(n) % 3 == 0, 5432, 9999)
+        recs = _records(db.table_slot, _ip_u32(web.ipv4),
+                        _ip_u32(db.ipv4),
+                        sports=43000 + np.arange(n), dports=dports)
+        v, ids = client.classify(recs)
+        assert len(v) == n
+        want_allow = np.arange(n) % 3 == 0
+        assert ((v >= 0) == want_allow).all()
+        assert svc.batches_dispatched > 1  # really split
+        client.close()
+    finally:
+        svc.shutdown()
+
+
+def test_pipelined_clients_from_threads(wired_daemon):
+    d, web, db = wired_daemon
+    svc = VerdictService(d.datapath).start()
+    errors = []
+
+    def worker(base):
+        try:
+            client = VerdictClient("127.0.0.1", svc.port)
+            for k in range(10):
+                recs = _records(db.table_slot, _ip_u32(web.ipv4),
+                                _ip_u32(db.ipv4),
+                                sports=[base + k], dports=[5432])
+                v, _ = client.classify(recs)
+                if not v[0] >= 0:
+                    errors.append((base, k, int(v[0])))
+            client.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    try:
+        threads = [threading.Thread(target=worker, args=(50000 + i * 100,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+    finally:
+        svc.shutdown()
+
+
+def test_protocol_error_drops_connection(wired_daemon):
+    d, _web, _db = wired_daemon
+    svc = VerdictService(d.datapath).start()
+    try:
+        import socket as _socket
+        s = _socket.create_connection(("127.0.0.1", svc.port),
+                                      timeout=5)
+        s.sendall(struct.pack(">III", 0xBAD, 1, 4))
+        s.settimeout(5)
+        assert s.recv(1) == b""  # server closed on us
+        s.close()
+    finally:
+        svc.shutdown()
+
+
+def test_dispatcher_failure_closes_connection_not_hangs():
+    """Review regression: a classify error (e.g. no policy loaded)
+    must drop the connection so the client fails fast instead of
+    hanging until its socket timeout."""
+    from cilium_tpu.datapath.engine import Datapath
+    bare = Datapath(ct_slots=1 << 10)  # no policy loaded -> raises
+    svc = VerdictService(bare).start()
+    try:
+        client = VerdictClient("127.0.0.1", svc.port, timeout=10)
+        recs = np.zeros(4, PKT_HEADER_DTYPE)
+        recs["proto"] = 6
+        with pytest.raises(VerdictServiceError):
+            client.classify(recs)
+        client.close()
+    finally:
+        svc.shutdown()
