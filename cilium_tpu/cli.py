@@ -449,12 +449,25 @@ def cmd_agent(args) -> int:
     d = Daemon(config=cfg, kvstore_backend=kv, node_name=args.node_name)
     restored = d.restore_endpoints()
     server = APIServer(d, port=args.api_port).start()
+    vsvc = None
+    if getattr(args, "verdict_port", 0):
+        # the daemon->TPU verdict-service RPC hop: remote ingest
+        # points ship header batches here (verdict_service.py)
+        from .verdict_service import VerdictService
+        try:
+            vsvc = VerdictService(d.datapath,
+                                  port=args.verdict_port).start()
+        except RuntimeError as e:   # native build unavailable
+            print(f"verdict service disabled: {e}")
     print(f"cilium-tpu agent up: api={server.base_url} "
-          f"restored={restored} endpoints")
+          f"restored={restored} endpoints" +
+          (f" verdict-service=:{vsvc.port}" if vsvc else ""))
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        if vsvc is not None:
+            vsvc.shutdown()
         server.shutdown()
         d.shutdown()
     return 0
@@ -603,6 +616,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     ag = sub.add_parser("agent", help="run the agent")
     ag.add_argument("--api-port", type=int, default=9234)
+    ag.add_argument("--verdict-port", type=int, default=0,
+                    help="serve the batch verdict service on this "
+                         "port (0 = disabled)")
     ag.add_argument("--kvstore", default="none",
                     help="none | in-memory | backend name")
     ag.add_argument("--cluster-name", default="default")

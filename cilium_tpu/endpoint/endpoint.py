@@ -348,4 +348,7 @@ class Endpoint:
                 "labels": [str(l) for l in self.labels.to_array()],
                 "policy-revision": self.policy_revision,
                 "policy-enabled": self.opts.is_enabled("Policy"),
+                # device-table row: verdict-service clients address
+                # packets by this slot, not the endpoint id
+                "table-slot": self.table_slot,
             }

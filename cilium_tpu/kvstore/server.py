@@ -73,8 +73,6 @@ def recv_frame(sock: socket.socket) -> Optional[dict]:
     return json.loads(body)
 
 
-
-
 def _b64(value: bytes) -> str:
     return base64.b64encode(value).decode()
 

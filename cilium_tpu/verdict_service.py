@@ -104,6 +104,7 @@ class VerdictService:
         frames_lock = threading.Lock()
         eof = threading.Event()
         wake = threading.Event()
+        dead = threading.Event()  # dispatcher exited (error or EOF)
 
         def dispatcher():
             try:
@@ -140,15 +141,15 @@ class VerdictService:
                                         idents[off:n], True))
                     for item in out:
                         self._send_resp(sock, item, partials)
-            except OSError:
-                pass
-            except Exception:  # noqa: BLE001 — e.g. "no policy
-                # loaded" mid-recompile: a dead dispatcher must not
-                # leave the client hanging until its timeout
+            except Exception:  # noqa: BLE001 — send failure or e.g.
+                # "no policy loaded" mid-recompile: a dead dispatcher
+                # must not leave the client hanging until its timeout
                 try:
                     sock.shutdown(socket.SHUT_RDWR)
                 except OSError:
                     pass
+            finally:
+                dead.set()  # unblocks a reader stuck on a full ring
 
         # partial-frame reassembly buffer: frame_id -> [verdicts, ids]
         partials = {}
@@ -172,6 +173,8 @@ class VerdictService:
                     frames.append((frame_id, count))
                 pushed = 0
                 while pushed < count:
+                    if dead.is_set():
+                        return  # nobody will ever drain the ring
                     got = ring.push(recs[pushed:], drop_on_full=False)
                     pushed += got
                     wake.set()
@@ -266,6 +269,9 @@ class VerdictClient:
                  ) -> Tuple[np.ndarray, np.ndarray]:
         from .native import PKT_HEADER_DTYPE
         recs = np.ascontiguousarray(records, PKT_HEADER_DTYPE)
+        if len(recs) == 0:   # the server treats count=0 as a protocol
+            return (np.empty(0, np.int32),   # error — short-circuit
+                    np.empty(0, np.int32))
         with self._lock:
             fid = self._next_id
             self._next_id += 1

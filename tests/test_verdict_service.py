@@ -179,3 +179,32 @@ def test_dispatcher_failure_closes_connection_not_hangs():
         client.close()
     finally:
         svc.shutdown()
+
+
+def test_agent_verdict_port_flag_parses():
+    """--verdict-port parse contract (service construction itself is
+    covered by the tests above; cmd_agent's loop is not runnable
+    in-process)."""
+    from cilium_tpu.cli import build_parser
+    args = build_parser().parse_args(["agent", "--verdict-port", "0"])
+    assert args.verdict_port == 0
+    args = build_parser().parse_args(
+        ["agent", "--verdict-port", "19999"])
+    assert args.verdict_port == 19999
+
+
+def test_client_empty_batch_short_circuits(wired_daemon):
+    d, _web, _db = wired_daemon
+    svc = VerdictService(d.datapath).start()
+    try:
+        client = VerdictClient("127.0.0.1", svc.port)
+        v, ids = client.classify(np.zeros(0, PKT_HEADER_DTYPE))
+        assert len(v) == 0 and len(ids) == 0
+        # the connection survives for real work afterwards
+        recs = np.zeros(1, PKT_HEADER_DTYPE)
+        recs["proto"] = 6
+        v, _ = client.classify(recs)
+        assert len(v) == 1
+        client.close()
+    finally:
+        svc.shutdown()
