@@ -418,12 +418,13 @@ def cmd_cleanup(c: Client, args) -> int:
     removed = 0
     if os.path.isdir(state):
         for fname in sorted(os.listdir(state)):
-            if fname.startswith("ep_") and fname.endswith(".json"):
+            if (fname.startswith("ep_") and fname.endswith(".json")) \
+                    or fname == "ct_state.npz":
                 os.unlink(os.path.join(state, fname))
                 removed += 1
         if args.all:
             shutil.rmtree(state, ignore_errors=True)
-    print(f"removed {removed} endpoint checkpoint(s) from {state}")
+    print(f"removed {removed} checkpoint file(s) from {state}")
     return 0
 
 
@@ -457,7 +458,10 @@ def cmd_agent(args) -> int:
         try:
             vsvc = VerdictService(d.datapath,
                                   port=args.verdict_port).start()
-        except RuntimeError as e:   # native build unavailable
+        except (RuntimeError, OSError) as e:
+            # native build unavailable (g++ missing raises
+            # FileNotFoundError) or the port is taken — the agent
+            # still runs, just without the batch RPC surface
             print(f"verdict service disabled: {e}")
     print(f"cilium-tpu agent up: api={server.base_url} "
           f"restored={restored} endpoints" +

@@ -18,6 +18,8 @@ import json
 import os
 import threading
 import time
+
+import numpy as np
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import identity as idpkg
@@ -563,10 +565,12 @@ class Daemon:
 
     def restore_endpoints(self) -> int:
         """daemon/state.go restoreOldEndpoints: reload checkpoints,
-        re-resolve identities, queue rebuilds."""
+        re-resolve identities, queue rebuilds.  Also reloads the CT
+        checkpoint so established flows keep forwarding."""
         state_dir = self.config.state_dir
         if not state_dir or not os.path.isdir(state_dir):
             return 0
+        self.restore_ct()
         n = 0
         for fname in sorted(os.listdir(state_dir)):
             if not (fname.startswith("ep_") and fname.endswith(".json")):
@@ -858,3 +862,54 @@ class Daemon:
             self._ip_watcher.stop()
         if self.node_registry is not None:
             self.node_registry.close()
+        self.checkpoint_ct()
+
+    # ------------------------------------------- conntrack persistence
+
+    def checkpoint_ct(self) -> bool:
+        """Persist both CT tables (the pinned-ctmap analog): on the
+        next start, restore_ct() lets established flows keep their
+        verdicts while the agent was down (daemon/state.go + pinned
+        bpf maps semantics)."""
+        if not self.config.state_dir:
+            return False
+        try:
+            os.makedirs(self.config.state_dir, exist_ok=True)
+            path = os.path.join(self.config.state_dir, "ct_state.npz")
+            v4, v6 = self.datapath.snapshot_ct()
+            # tmp + rename, like Endpoint.write_checkpoint: a crash
+            # mid-write must not destroy the previous good checkpoint
+            # (tmp keeps the .npz suffix — numpy appends one otherwise)
+            tmp = f"{path[:-4]}.tmp{os.getpid()}.npz"
+            np.savez_compressed(
+                tmp, __version__=np.array([1], np.int64),
+                **{f"v4_{k}": v for k, v in v4.items()},
+                **{f"v6_{k}": v for k, v in v6.items()})
+            os.replace(tmp, path)
+            return True
+        except OSError:
+            return False
+
+    def restore_ct(self) -> int:
+        """Reload checkpointed CT state; returns live entries restored
+        (0 when absent or geometry-incompatible — a cold start)."""
+        if not self.config.state_dir:
+            return 0
+        path = os.path.join(self.config.state_dir, "ct_state.npz")
+        # prepare BOTH tables before assigning either, and treat any
+        # corruption (truncated zip, missing members, geometry change,
+        # unknown version) as a cold start — never a crash, never a
+        # half-restored table
+        try:
+            with np.load(path) as z:
+                if int(np.asarray(z["__version__"])[0]) != 1:
+                    return 0
+                v4 = {k[3:]: z[k] for k in z.files
+                      if k.startswith("v4_")}
+                v6 = {k[3:]: z[k] for k in z.files
+                      if k.startswith("v6_")}
+            return self.datapath.restore_ct_snapshots(v4, v6)
+        except Exception:  # noqa: BLE001 — np.load raises zipfile/
+            return 0       # zlib/pickle errors beyond OSError; a bad
+            # snapshot (geometry/fields) is a cold start, never a
+            # crash or a half-restored table

@@ -25,7 +25,7 @@ implemented as a device scan.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -317,3 +317,35 @@ class ConntrackTable:
 
     def entry_count(self) -> int:
         return int((np.asarray(self.state.k3[:-1]) != 0).sum())
+
+    def snapshot(self) -> Dict[str, "np.ndarray"]:
+        """Host copy of every CT field — the pinned-ctmap analog: the
+        reference's conntrack survives agent restarts because the bpf
+        map stays pinned; here the state is checkpointed and restored
+        so established flows keep their verdicts across a restart."""
+        out = {f: np.asarray(getattr(self.state, f))
+               for f in CTState._fields}
+        out["slots"] = np.array([self.slots], np.int64)
+        return out
+
+    def prepare_snapshot(self, arrays: Dict[str, "np.ndarray"]
+                         ) -> CTState:
+        """Validate + build a CTState from a snapshot WITHOUT mutating
+        the table — callers prepare every table first, then assign, so
+        a bad snapshot can never leave half-restored state.  Slot
+        positions encode the hash placement, so a geometry change
+        invalidates the snapshot (ValueError; callers start cold —
+        exactly what cilium-map-migrate refuses to carry across
+        incompatible layouts)."""
+        slots = int(np.asarray(arrays["slots"])[0])
+        if slots != self.slots:
+            raise ValueError(
+                f"CT snapshot geometry {slots} != table {self.slots}")
+        return CTState(**{
+            f: jnp.asarray(np.asarray(arrays[f], np.int32))
+            for f in CTState._fields})
+
+    def restore_snapshot(self, arrays: Dict[str, "np.ndarray"]) -> int:
+        """prepare_snapshot + assign; returns live entries restored."""
+        self.state = self.prepare_snapshot(arrays)
+        return self.entry_count()

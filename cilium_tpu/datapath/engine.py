@@ -336,6 +336,32 @@ class Datapath:
                 jnp.int32(now if now is not None else int(time.time())))
             return verdict, event, identity, nat
 
+    def ct_entries(self) -> Tuple[int, int]:
+        """(v4, v6) live CT entry counts, serialized against the gc
+        controller's buffer donation (an unlocked entry_count can read
+        a deleted array mid-gc)."""
+        with self._lock:
+            return self.ct.entry_count(), self.ct6.entry_count()
+
+    def snapshot_ct(self):
+        """(v4, v6) CT snapshots, serialized against process/gc — the
+        gc step DONATES the state buffers, so an unlocked read can see
+        a deleted array."""
+        with self._lock:
+            return self.ct.snapshot(), self.ct6.snapshot()
+
+    def restore_ct_snapshots(self, v4, v6) -> int:
+        """Validate + swap in both CT snapshots atomically (both
+        prepared before either is assigned); returns entries restored.
+        Raises ValueError/KeyError on a bad snapshot — callers treat
+        that as a cold start."""
+        with self._lock:
+            st4 = self.ct.prepare_snapshot(v4)
+            st6 = self.ct6.prepare_snapshot(v6)
+            self.ct.state = st4
+            self.ct6.state = st6
+            return self.ct.entry_count() + self.ct6.entry_count()
+
     # -- map dump surface (cilium bpf */list analogs) -----------------------
 
     def map_inventory(self) -> Dict[str, Dict]:

@@ -720,7 +720,7 @@ def test_cli_debuginfo_kvstore_cleanup(capsys, tmp_path):
         assert main(["cleanup", "--state-dir", state]) == 1
         capsys.readouterr()
         assert main(["cleanup", "-f", "--state-dir", state]) == 0
-        assert "endpoint checkpoint(s)" in capsys.readouterr().out
+        assert "checkpoint file(s)" in capsys.readouterr().out
         assert not os.path.exists(os.path.join(state, "ep_99.json"))
         assert not os.path.exists(os.path.join(state, "ep_21.json"))
     finally:
@@ -738,3 +738,68 @@ def test_kvstore_routes_503_without_backend():
         assert "503" in str(exc.value)
     finally:
         d.shutdown()
+
+
+def test_established_flows_survive_agent_restart(tmp_path):
+    """The pinned-ctmap analog: conntrack state checkpoints at
+    shutdown and restores at start, so flows established under the old
+    policy keep their verdicts across a restart — even before policy
+    is re-imported — while NEW flows hit the (empty) policy and drop.
+    Reference: daemon/state.go + bpf pinned maps."""
+    state = str(tmp_path / "state")
+    d1 = Daemon(config=DaemonConfig(state_dir=state))
+    d1.endpoint_create(11, ipv4="10.0.0.11", labels=["k8s:id=server"])
+    d1.endpoint_create(12, ipv4="10.0.0.12", labels=["k8s:id=client"])
+    d1.policy_add(rules_from_json(RULES_JSON))
+    assert d1.wait_for_policy_revision()
+    slot = d1.endpoints.lookup(11).table_slot
+    flow = dict(endpoint=[slot], saddr=["10.0.0.12"],
+                daddr=["10.0.0.11"], sport=[45123], dport=[9999],
+                direction=[0])
+    verdict, *_ = d1.datapath.process(make_full_batch(**flow))
+    assert int(np.asarray(verdict)[0]) == 0  # established under policy
+    ct_before = d1.datapath.ct_entries()[0]
+    assert ct_before > 0
+    d1.shutdown()
+
+    d2 = Daemon(config=DaemonConfig(state_dir=state))
+    assert d2.restore_endpoints() == 2
+    assert d2.datapath.ct_entries()[0] == ct_before
+    assert d2.wait_for_quiesce(15)
+    # same 5-tuple: CT hit, still forwarded (no policy re-imported!)
+    verdict, *_ = d2.datapath.process(make_full_batch(**flow))
+    assert int(np.asarray(verdict)[0]) == 0
+    # fresh flow: CT_NEW against the empty policy -> drop
+    fresh = dict(flow, sport=[45999])
+    verdict, *_ = d2.datapath.process(make_full_batch(**fresh))
+    assert int(np.asarray(verdict)[0]) < 0
+    d2.shutdown()
+
+
+def test_ct_restore_rejects_changed_geometry(tmp_path):
+    state = str(tmp_path / "state")
+    d1 = Daemon(config=DaemonConfig(state_dir=state))
+    d1.endpoint_create(13, ipv4="10.0.0.13", labels=["k8s:a=b"])
+    assert d1.wait_for_quiesce(10)
+    d1.shutdown()
+    # different CT table size: snapshot refused, cold start, no crash
+    d2 = Daemon(config=DaemonConfig(state_dir=state, ct_slots=1 << 10))
+    assert d2.restore_ct() == 0
+    assert d2.datapath.ct_entries()[0] == 0
+    d2.shutdown()
+
+
+def test_ct_restore_survives_corrupt_checkpoint(tmp_path):
+    """Review regression: a truncated/corrupt ct_state.npz must cold-
+    start the agent, never crash it or half-restore one family."""
+    import os
+    state = str(tmp_path / "state")
+    os.makedirs(state)
+    with open(os.path.join(state, "ct_state.npz"), "wb") as f:
+        f.write(b"PK\x03\x04garbage-truncated")
+    d = Daemon(config=DaemonConfig(state_dir=state))
+    assert d.restore_ct() == 0
+    assert d.datapath.ct_entries()[0] == 0
+    # and restore_endpoints (which calls restore_ct) doesn't raise
+    assert d.restore_endpoints() == 0
+    d.shutdown()
