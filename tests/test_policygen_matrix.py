@@ -23,9 +23,10 @@ from cilium_tpu.daemon import Daemon
 from cilium_tpu.daemon.daemon import DaemonConfig
 from cilium_tpu.datapath.engine import make_full_batch
 from cilium_tpu.labels import LabelArray
-from cilium_tpu.policy.api import (Decision, EndpointSelector,
-                                   IngressRule, L7Rules, PortProtocol,
-                                   PortRule, PortRuleHTTP, Rule)
+from cilium_tpu.policy.api import (Decision, EgressRule,
+                                   EndpointSelector, IngressRule,
+                                   L7Rules, PortProtocol, PortRule,
+                                   PortRuleHTTP, Rule)
 from cilium_tpu.policy.trace import Port, SearchContext
 
 APPS = ["web", "db", "cache", "api"]
@@ -80,6 +81,98 @@ def _expect_redirect(specs, src_app, dst_app, port):
                 (src is None or src == src_app):
             return True
     return False
+
+
+def _gen_egress_rules(rng):
+    """Random EGRESS rules: L3-only / L4 / dst-wildcard shapes."""
+    rules = []
+    for _ in range(rng.integers(2, 6)):
+        src = APPS[rng.integers(0, len(APPS))]
+        kind = rng.integers(0, 3)
+        dst = APPS[rng.integers(0, len(APPS))] if kind != 2 else None
+        tos = [EndpointSelector.parse(f"app={dst}")] if dst else []
+        if kind == 0:                       # L3-only egress
+            rules.append(Rule(
+                endpoint_selector=EndpointSelector.parse(f"app={src}"),
+                egress=[EgressRule(to_endpoints=tos)]))
+            continue
+        port = PORTS[rng.integers(0, len(PORTS))]
+        pr = PortRule(ports=[PortProtocol(port=str(port),
+                                          protocol="TCP")])
+        rules.append(Rule(
+            endpoint_selector=EndpointSelector.parse(f"app={src}"),
+            egress=[EgressRule(to_endpoints=tos, to_ports=[pr])]))
+    return rules
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_policygen_matrix_egress(seed):
+    """Three-way agreement for the EGRESS direction: repository
+    oracle (allows_egress), the device datapath with direction=1
+    (the from-container path, bpf_lxc.c handle_ipv4_from_lxc), and
+    the C++ host fast path."""
+    rng = np.random.default_rng(seed)
+    d = Daemon(config=DaemonConfig())
+    try:
+        eps = {}
+        for i, app in enumerate(APPS):
+            eps[app] = d.endpoint_create(
+                200 + i, ipv4=f"10.200.8.{10 + i}",
+                labels=[f"k8s:app={app}"])
+        rules = _gen_egress_rules(rng)
+        d.policy_add(rules)
+        assert d.wait_for_quiesce(30)
+
+        flows = [(src, dst, port)
+                 for src in APPS for dst in APPS if src != dst
+                 for port in PORTS + [STRANGER_PORT]]
+        expected = []
+        for src, dst, port in flows:
+            ctx = SearchContext(
+                from_labels=LabelArray.parse_select(f"app={src}"),
+                to_labels=LabelArray.parse_select(f"app={dst}"),
+                dports=[Port(port, "TCP")])
+            expected.append(d.repo.allows_egress(ctx))
+
+        batch = make_full_batch(
+            endpoint=[eps[src].table_slot for src, _, _ in flows],
+            saddr=[eps[src].ipv4 for src, _, _ in flows],
+            daddr=[eps[dst].ipv4 for _, dst, _ in flows],
+            sport=[46000 + i for i in range(len(flows))],
+            dport=[p for _, _, p in flows],
+            direction=[1] * len(flows))
+        verdict, _ev, identity, _nat = d.datapath.process(batch)
+        v = np.asarray(verdict)
+        ids = np.asarray(identity)
+        for i, (src, dst, port) in enumerate(flows):
+            assert ids[i] == eps[dst].security_identity, (dst, ids[i])
+            if expected[i] == Decision.ALLOWED:
+                assert v[i] >= 0, \
+                    f"seed {seed} egress {src}->{dst}:{port} " \
+                    f"oracle ALLOWED, device {v[i]}"
+            else:
+                assert v[i] < 0, \
+                    f"seed {seed} egress {src}->{dst}:{port} " \
+                    f"oracle {expected[i]}, device {v[i]}"
+
+        # host fast path agrees on the egress direction too
+        if d.host_path is not None:
+            for src in APPS:
+                rows = [i for i, f in enumerate(flows) if f[0] == src]
+                hv = d.host_path.classify(
+                    eps[src].id,
+                    np.array([eps[flows[i][1]].security_identity
+                              for i in rows], np.uint32),
+                    np.array([flows[i][2] for i in rows], np.int32),
+                    np.full(len(rows), 6, np.int32),
+                    np.ones(len(rows), np.int32))
+                for j, i in enumerate(rows):
+                    same = (hv[j] < 0) == (v[i] < 0)
+                    assert same, \
+                        f"seed {seed} egress host/device diverge on " \
+                        f"{flows[i]}: host {hv[j]} device {v[i]}"
+    finally:
+        d.shutdown()
 
 
 @pytest.mark.parametrize("seed", [1, 7, 23])
