@@ -252,3 +252,80 @@ def test_policygen_matrix_oracle_device_host_agree(seed):
                         f"{flows[i]}: host {hv[j]} device {v[i]}"
     finally:
         d.shutdown()
+
+
+def test_policygen_matrix_v6():
+    """Generated matrices for the IPv6 path: random mapstates + v6
+    prefixes; every flow's device verdict (full_datapath_step6) and
+    resolved identity must match the scalar oracle + a host LPM."""
+    import ipaddress
+    from cilium_tpu.compiler.policy_tables import oracle_verdict
+    from cilium_tpu.datapath.engine import Datapath, make_full_batch6
+    from cilium_tpu.identity import RESERVED_WORLD
+    from cilium_tpu.policy.mapstate import (INGRESS, PolicyKey,
+                                            PolicyMapState,
+                                            PolicyMapStateEntry)
+    rng = np.random.default_rng(17)
+    idents = [700 + i for i in range(6)]
+    prefixes = {}
+    for i, ident in enumerate(idents):
+        plen = int(rng.choice([48, 56, 64]))
+        net = ipaddress.ip_network(
+            f"2001:db8:{i + 1:x}::/{plen}", strict=False)
+        prefixes[str(net)] = ident
+
+    st = PolicyMapState()
+    rules = []  # (identity, port) installed allows
+    for _ in range(12):
+        ident = int(rng.choice(idents))
+        port = int(rng.integers(1, 1 << 16))
+        st[PolicyKey(identity=ident, dest_port=port, nexthdr=6,
+                     direction=INGRESS)] = PolicyMapStateEntry(
+            proxy_port=int(rng.integers(0, 2)) * 14001)
+        rules.append((ident, port))
+    # one L3-only and one L4-wildcard entry exercise stages 2/3
+    st[PolicyKey(identity=idents[0],
+                 direction=INGRESS)] = PolicyMapStateEntry()
+    st[PolicyKey(identity=0, dest_port=443, nexthdr=6,
+                 direction=INGRESS)] = PolicyMapStateEntry()
+
+    dp = Datapath(ct_slots=1 << 10, ct_probe=4)
+    dp.load_policy([st], revision=1, ipcache_prefixes={})
+    dp.load_ipcache6(prefixes)
+
+    def host_identity(addr):
+        # the shared scalar LPM oracle (compiler/lpm.py) — one
+        # reference implementation, not a per-test re-derivation
+        from cilium_tpu.compiler.lpm import LPM_MISS, oracle_lpm
+        v = oracle_lpm(prefixes, addr)
+        return RESERVED_WORLD if v == LPM_MISS else v
+
+    flows = []
+    for k in range(120):
+        if k % 3 == 0:            # address inside a known prefix
+            pick = list(prefixes)[rng.integers(0, len(prefixes))]
+            net = ipaddress.ip_network(pick)
+            addr = str(net.network_address + int(rng.integers(1, 999)))
+        else:                      # mix of known + stranger space
+            addr = f"2001:db8:{rng.integers(1, 16):x}::{k + 1:x}" \
+                if k % 3 == 1 else f"fd00::{k + 1:x}"
+        port = rules[rng.integers(0, len(rules))][1] \
+            if rng.random() < 0.5 else int(rng.integers(1, 1 << 16))
+        flows.append((addr, port))
+
+    batch = make_full_batch6(
+        endpoint=[0] * len(flows),
+        saddr=[a for a, _ in flows],
+        daddr=["2001:db8:ff::1"] * len(flows),
+        sport=[47000 + i for i in range(len(flows))],
+        dport=[p for _, p in flows],
+        direction=[0] * len(flows))
+    verdict, _ev, identity, _n = dp.process6(batch, now=50)
+    v = np.asarray(verdict)
+    ids = np.asarray(identity)
+    for i, (addr, port) in enumerate(flows):
+        want_id = host_identity(addr)
+        assert ids[i] == want_id, (addr, ids[i], want_id)
+        want_v = oracle_verdict(st, want_id, port, 6, INGRESS)
+        assert v[i] == want_v, \
+            f"{addr}:{port} id={want_id} device {v[i]} oracle {want_v}"
