@@ -1,0 +1,124 @@
+"""Chaos: concurrent policy churn + endpoint churn + classification.
+
+The test/runtime/chaos.go analog: the reference restarts agents and
+mutates policy under live traffic and asserts the system converges.
+Here four thread families hammer one daemon — policy add/delete,
+endpoint create/delete, device-batch classification, host fast-path
+classification — and afterwards the daemon must still give exactly
+the right verdicts.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.daemon.daemon import DaemonConfig
+from cilium_tpu.datapath.engine import make_full_batch
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.policy.api import (EndpointSelector, IngressRule,
+                                   PortProtocol, PortRule, Rule)
+
+DURATION_S = 4.0
+
+
+def test_concurrent_churn_converges():
+    d = Daemon(config=DaemonConfig())
+    errors = []
+    stop = threading.Event()
+    try:
+        web = d.endpoint_create(1, ipv4="10.200.5.1",
+                                labels=["k8s:app=web"])
+        db = d.endpoint_create(2, ipv4="10.200.5.2",
+                               labels=["k8s:app=db"])
+        base_rule = Rule(
+            endpoint_selector=EndpointSelector.parse("app=db"),
+            ingress=[IngressRule(
+                from_endpoints=[EndpointSelector.parse("app=web")],
+                to_ports=[PortRule(ports=[
+                    PortProtocol(port="5432", protocol="TCP")])])],
+            labels=LabelArray.parse("rule=base"))
+        d.policy_add([base_rule])
+        assert d.wait_for_quiesce(30)
+
+        def guard(fn):
+            def run():
+                k = 0
+                while not stop.is_set():
+                    try:
+                        fn(k)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+                        return
+                    k += 1
+            return run
+
+        def policy_churn(k):
+            # a second rule flaps; the base rule must keep holding
+            r = Rule(endpoint_selector=EndpointSelector.parse("app=web"),
+                     ingress=[IngressRule(
+                         from_endpoints=[
+                             EndpointSelector.parse("app=db")])],
+                     labels=LabelArray.parse("rule=flap"))
+            d.policy_add([r])
+            time.sleep(0.01)
+            d.policy_delete(LabelArray.parse("rule=flap"))
+
+        def endpoint_churn(k):
+            eid = 50 + (k % 5)
+            d.endpoint_create(eid, ipv4=f"10.200.5.{100 + k % 5}",
+                              labels=["k8s:app=churn"])
+            time.sleep(0.005)
+            d.endpoint_delete(eid)
+
+        def device_classify(k):
+            batch = make_full_batch(
+                endpoint=[db.table_slot], saddr=["10.200.5.1"],
+                daddr=["10.200.5.2"], sport=[40000 + (k % 20000)],
+                dport=[5432], direction=[0])
+            v, *_ = d.datapath.process(batch)
+            if int(np.asarray(v)[0]) < 0:
+                errors.append(f"allowed flow dropped at k={k}")
+
+        def host_classify(k):
+            if d.host_path is None:
+                stop.wait(0.01)
+                return
+            d.host_path.classify(
+                db.id, np.array([web.security_identity], np.uint32),
+                np.array([5432], np.int32), np.array([6], np.int32),
+                np.zeros(1, np.int32))
+
+        threads = [threading.Thread(target=guard(fn), daemon=True)
+                   for fn in (policy_churn, endpoint_churn,
+                              device_classify, host_classify)]
+        for t in threads:
+            t.start()
+        time.sleep(DURATION_S)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "worker wedged"
+        assert not errors, errors[:5]
+
+        # convergence: quiesce, then exact verdicts both tiers
+        assert d.wait_for_quiesce(30)
+        batch = make_full_batch(
+            endpoint=[db.table_slot, db.table_slot],
+            saddr=["10.200.5.1", "10.200.5.1"],
+            daddr=["10.200.5.2", "10.200.5.2"],
+            sport=[61001, 61002], dport=[5432, 80], direction=[0, 0])
+        v, *_ = d.datapath.process(batch)
+        assert int(np.asarray(v)[0]) >= 0
+        assert int(np.asarray(v)[1]) < 0
+        if d.host_path is not None:
+            hv = d.host_path.classify(
+                db.id,
+                np.array([web.security_identity] * 2, np.uint32),
+                np.array([5432, 80], np.int32),
+                np.full(2, 6, np.int32), np.zeros(2, np.int32))
+            assert hv[0] >= 0 and hv[1] < 0
+    finally:
+        stop.set()
+        d.shutdown()

@@ -309,8 +309,15 @@ def test_policygen_matrix_v6():
         else:                      # mix of known + stranger space
             addr = f"2001:db8:{rng.integers(1, 16):x}::{k + 1:x}" \
                 if k % 3 == 1 else f"fd00::{k + 1:x}"
-        port = rules[rng.integers(0, len(rules))][1] \
-            if rng.random() < 0.5 else int(rng.integers(1, 1 << 16))
+        # thirds: installed rule ports / the 443 L4-wildcard /
+        # uniform strangers — every lookup stage gets real coverage
+        roll = rng.random()
+        if roll < 0.4:
+            port = rules[rng.integers(0, len(rules))][1]
+        elif roll < 0.6:
+            port = 443  # hits the (identity=0, 443) wildcard entry
+        else:
+            port = int(rng.integers(1, 1 << 16))
         flows.append((addr, port))
 
     batch = make_full_batch6(
