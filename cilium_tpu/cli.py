@@ -296,6 +296,10 @@ def cmd_monitor(c: Client, args) -> int:
     if args.socket:
         # true subscriber stream from a separate process: no polling,
         # no dedupe needed — the server pushes each sample once
+        if args.type:
+            print("monitor: --type applies to the polling mode only "
+                  "(the socket stream is unfiltered)", file=sys.stderr)
+            return 2
         from .monitor import monitor_follow
         host, sep, port = args.socket.rpartition(":")
         if not sep or not port.isdigit():
@@ -310,10 +314,12 @@ def cmd_monitor(c: Client, args) -> int:
     # events in one batch share a timestamp, so dedupe on the full
     # event tuple (bounded), not the timestamp alone
     seen = set()
+    kind_q = f"&kind={args.type}" if args.type else ""
     try:
         while True:
             events = c.get(
-                f"/monitor?n=200&drops={'true' if args.drops else 'false'}")
+                f"/monitor?n=200&drops="
+                f"{'true' if args.drops else 'false'}{kind_q}")
             for e in events:
                 key = (e["timestamp"], e["code"], e["endpoint"],
                        e["identity"], e["dport"], e["proto"],
@@ -564,6 +570,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     mon = sub.add_parser("monitor", help="datapath event monitor")
     mon.add_argument("--drops", action="store_true")
+    mon.add_argument("--type", default="",
+                     choices=["", "agent", "l7", "datapath"],
+                     help="event family filter (cilium monitor --type)")
     mon.add_argument("--stats", action="store_true")
     mon.add_argument("-f", "--follow", action="store_true")
     mon.add_argument("--interval", type=float, default=1.0)

@@ -326,3 +326,25 @@ def test_monitor_rest_kind_filters():
                                   for e in only_agent)
     finally:
         d.shutdown()
+
+
+def test_cli_monitor_type_filter(capsys):
+    """cilium monitor --type agent|l7|datapath (monitor --type analog)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from cilium_tpu.cli import main
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.utils.option import DaemonConfig
+    d = Daemon(config=DaemonConfig())
+    srv = APIServer(d).start()
+    try:
+        d.endpoint_create(81, ipv4="10.200.0.81", labels=["k8s:q=r"])
+        d.wait_for_quiesce(10)
+        assert main(["--api", srv.base_url, "monitor",
+                     "--type", "agent"]) == 0
+        out = capsys.readouterr().out
+        assert "AGENT" in out and "endpoint-created id=81" in out
+        assert "TRACE" not in out and "DROP" not in out
+    finally:
+        d.shutdown()

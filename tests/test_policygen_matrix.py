@@ -309,7 +309,7 @@ def test_policygen_matrix_v6():
         else:                      # mix of known + stranger space
             addr = f"2001:db8:{rng.integers(1, 16):x}::{k + 1:x}" \
                 if k % 3 == 1 else f"fd00::{k + 1:x}"
-        # thirds: installed rule ports / the 443 L4-wildcard /
+        # 40/20/40: installed rule ports / the 443 L4-wildcard /
         # uniform strangers — every lookup stage gets real coverage
         roll = rng.random()
         if roll < 0.4:
