@@ -1,13 +1,16 @@
 """The ``cilium-tpu`` CLI.
 
 Mirrors the reference's ``cilium`` command families (cilium/cmd/, 75
-commands) against the REST API: policy {get,import,delete,trace},
-endpoint {list,get,config,labels,delete}, identity {list,get},
-service {list,update,delete}, prefilter {list,update,delete},
-monitor, status, config, metrics, and the map-dump debugging surface
-(``bpf policy list`` analog comes from /endpoint + /monitor/stats).
+commands) against the REST API: policy {get,import,delete,trace,
+validate,wait}, endpoint {list,get,config,labels,delete,log,
+regenerate,healthz}, identity {list,get}, service {list,update,
+delete}, prefilter {list,update,delete}, monitor (--type/--drops/
+--socket), status, config, metrics, node, map {list,get}, version,
+debuginfo, kvstore {get,set,delete}, cleanup, bugtool,
+migrate-state, plus the container front ends (cni, docker-plugin).
 
-Run the agent itself with ``cilium-tpu agent``.
+Run the agent itself with ``cilium-tpu agent`` (add --verdict-port
+to expose the batch verdict service).
 """
 
 from __future__ import annotations
