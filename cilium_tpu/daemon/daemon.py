@@ -53,6 +53,10 @@ from ..utils.option import DaemonConfig, parse_option_value
 from ..utils.trigger import Trigger
 from ..compiler.lpm import ipv4_to_u32
 
+# /service/{id} API ids: v6 services offset into a disjoint range
+# (each family allocates rev-NAT indices independently)
+V6_SERVICE_ID_BASE = 1_000_000
+
 
 class Daemon:
     """One agent instance."""
@@ -628,15 +632,49 @@ class Daemon:
         self.datapath.lb.upsert_service(svc)
         self.datapath.reload_services()
 
-    def service_delete(self, vip: str, port: int, proto: int = 6) -> bool:
-        if ":" in vip:
-            from ..compiler.lpm import ipv6_to_words
-            return self.datapath.delete_service6(ipv6_to_words(vip),
-                                                 port, proto)
-        ok = self.datapath.lb.delete_service(ipv4_to_u32(vip), port, proto)
+    def service_find_by_id(self, sid: int):
+        """Service lookup by API id — the reference addresses services
+        by numeric id in GET/DELETE /service/{id}
+        (daemon/loadbalancer.go).  The API id is the family's
+        rev_nat_index, offset by V6_SERVICE_ID_BASE for v6: the two
+        families allocate rev-NAT indices independently (both device
+        tables index by them), so the raw indices collide across
+        families and only the offset id is unique.  Returns a
+        Service/Service6 or None."""
+        if sid >= V6_SERVICE_ID_BASE:
+            target = sid - V6_SERVICE_ID_BASE
+            for svc6 in self.datapath.lb6_service_list():
+                if svc6.rev_nat_index == target:
+                    return svc6
+            return None
+        for svc in self.datapath.lb.services():
+            if svc.rev_nat_index == sid:
+                return svc
+        return None
+
+    def service_delete_by_id(self, sid: int) -> bool:
+        svc = self.service_find_by_id(sid)
+        if svc is None:
+            return False
+        return self._service_delete_raw(svc.vip, svc.port, svc.proto)
+
+    def _service_delete_raw(self, vip_raw, port: int,
+                            proto: int) -> bool:
+        """One delete body for both address families and both the
+        by-id and by-(vip,port) surfaces."""
+        if isinstance(vip_raw, tuple):          # v6 family
+            return self.datapath.delete_service6(vip_raw, port, proto)
+        ok = self.datapath.lb.delete_service(vip_raw, port, proto)
         if ok:
             self.datapath.reload_services()
         return ok
+
+    def service_delete(self, vip: str, port: int, proto: int = 6) -> bool:
+        if ":" in vip:
+            from ..compiler.lpm import ipv6_to_words
+            return self._service_delete_raw(ipv6_to_words(vip), port,
+                                            proto)
+        return self._service_delete_raw(ipv4_to_u32(vip), port, proto)
 
     # -------------------------------------------------- prefilter
 

@@ -1,11 +1,14 @@
 """REST API for the daemon.
 
 Mirrors the reference's OpenAPI surface (api/v1/openapi.yaml) core
-paths: /healthz, /config, /policy, /policy/resolve, /endpoint,
-/endpoint/{id}, /endpoint/{id}/config, /identity, /identity/{id},
-/service, /prefilter, /ipam (+ /ipam/{ip}), plus /metrics (Prometheus
-text) and /monitor (event tail). Stdlib http.server — the reference
-serves REST over a unix socket; here TCP on localhost for the CLI.
+paths: /healthz, /config, /debuginfo, /policy, /policy/resolve,
+/endpoint, /endpoint/{id} (+ /config /healthz /labels /log
+/regenerate), /identity, /identity/{id}, /service, /service/{id},
+/prefilter, /ipam (+ /ipam/{ip}), /kvstore/{key}, /map, /map/{name},
+plus /metrics (Prometheus text) and /monitor (event tail) — every
+path in the reference's api/v1/openapi.yaml. Stdlib http.server —
+the reference serves REST over a unix socket; here TCP on localhost
+for the CLI.
 """
 
 from __future__ import annotations
@@ -299,6 +302,35 @@ class _Handler(BaseHTTPRequestHandler):
                     ok = d.service_delete(body["vip"], int(body["port"]),
                                           proto=int(body.get("proto", 6)))
                     return self._send(200 if ok else 404, {"deleted": ok})
+            m = re.fullmatch(r"/service/(\d+)", path)
+            if m:
+                # GET/DELETE /service/{id} (api/v1 service by id)
+                sid = int(m.group(1))
+                svc = d.service_find_by_id(sid)
+                if method == "GET":
+                    if svc is None:
+                        return self._error(404, "service not found")
+                    return self._send(200, _service_model(svc))
+                if method == "DELETE":
+                    if not d.service_delete_by_id(sid):
+                        return self._error(404, "service not found")
+                    return self._send(200, {"deleted": sid})
+            m = re.fullmatch(r"/endpoint/(\d+)/labels", path)
+            if m:
+                # GET/PUT /endpoint/{id}/labels (endpoint_labels.go)
+                ep = d.endpoints.lookup(int(m.group(1)))
+                if ep is None:
+                    return self._error(404, "endpoint not found")
+                if method == "GET":
+                    return self._send(200, {
+                        "labels": [str(l) for l in ep.labels.to_array()],
+                        "identity": ep.security_identity})
+                if method in ("PUT", "PATCH"):
+                    body = json.loads(self._body() or b"{}")
+                    changed = d.endpoint_update_labels(
+                        ep.id, body.get("labels", []))
+                    return self._send(200, {"ok": True,
+                                            "changed": changed})
             if path == "/prefilter":
                 if method == "GET":
                     cidrs, rev = d.datapath.prefilter.dump()
@@ -386,21 +418,21 @@ def _words_to_ipv6(words) -> str:
     return str(ipaddress.IPv6Address(v))
 
 
+def _service_model(svc) -> Dict:
+    from .daemon import V6_SERVICE_ID_BASE
+    v6 = isinstance(svc.vip, tuple)
+    addr = _words_to_ipv6 if v6 else _u32_to_ipv4
+    sid = svc.rev_nat_index + (V6_SERVICE_ID_BASE if v6 else 0)
+    return {"id": sid, "vip": addr(svc.vip),
+            "port": svc.port, "proto": svc.proto,
+            "backends": [{"ip": addr(b.addr), "port": b.port}
+                         for b in svc.backends]}
+
+
 def _service_dump(d: Daemon):
-    out = []
-    for svc in d.datapath.lb.services():
-        out.append({"vip": _u32_to_ipv4(svc.vip), "port": svc.port,
-                    "proto": svc.proto,
-                    "backends": [{"ip": _u32_to_ipv4(b.addr),
-                                  "port": b.port} for b in svc.backends]})
     # v6 services (lb6 registry) are part of the same audit surface
-    for svc6 in d.datapath.lb6_services.values():
-        out.append({"vip": _words_to_ipv6(svc6.vip), "port": svc6.port,
-                    "proto": svc6.proto,
-                    "backends": [{"ip": _words_to_ipv6(b.addr),
-                                  "port": b.port}
-                                 for b in svc6.backends]})
-    return out
+    return [_service_model(s) for s in d.datapath.lb.services()] + \
+        [_service_model(s) for s in d.datapath.lb6_service_list()]
 
 
 class APIServer:

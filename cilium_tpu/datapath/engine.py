@@ -336,6 +336,13 @@ class Datapath:
                 jnp.int32(now if now is not None else int(time.time())))
             return verdict, event, identity, nat
 
+    def lb6_service_list(self):
+        """Snapshot of the v6 service registry under the engine lock —
+        the threaded REST server must not iterate the live dict while
+        an upsert mutates it."""
+        with self._lock:
+            return list(self.lb6_services.values())
+
     def ct_entries(self) -> Tuple[int, int]:
         """(v4, v6) live CT entry counts, serialized against the gc
         controller's buffer donation (an unlocked entry_count can read

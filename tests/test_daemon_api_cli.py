@@ -803,3 +803,56 @@ def test_ct_restore_survives_corrupt_checkpoint(tmp_path):
     # and restore_endpoints (which calls restore_ct) doesn't raise
     assert d.restore_endpoints() == 0
     d.shutdown()
+
+
+def test_service_by_id_and_endpoint_labels_paths(agent):
+    """Exact openapi.yaml path parity: GET/DELETE /service/{id} and
+    GET/PUT /endpoint/{id}/labels (endpoint_labels.go analogs)."""
+    d, srv = agent
+    c = Client(srv.base_url)
+    c.put("/service", {"vip": "10.254.1.1", "port": 80,
+                       "backends": [{"ip": "10.0.0.5", "port": 8080}]})
+    svcs = c.get("/service")
+    assert svcs and all("id" in s for s in svcs)
+    sid = svcs[0]["id"]
+    one = c.get(f"/service/{sid}")
+    assert one["vip"] == "10.254.1.1" and one["port"] == 80
+    assert c.delete(f"/service/{sid}") == {"deleted": sid}
+    with pytest.raises(SystemExit):
+        c.get(f"/service/{sid}")  # gone -> 404
+
+    ep = d.endpoint_create(41, ipv4="10.200.0.41",
+                           labels=["k8s:app=orig"])
+    d.wait_for_quiesce(10)
+    got = c.get("/endpoint/41/labels")
+    assert "k8s:app=orig" in got["labels"]
+    assert got["identity"] == ep.security_identity
+    out = c.put("/endpoint/41/labels", {"labels": ["k8s:app=new"]})
+    assert out["changed"] is True
+    d.wait_for_quiesce(10)
+    got = c.get("/endpoint/41/labels")
+    assert "k8s:app=new" in got["labels"]
+
+
+def test_service_ids_disjoint_across_families(agent):
+    """Review regression: v4 and v6 rev-NAT indices collide (separate
+    counters), so the /service/{id} API id must be family-disjoint —
+    each family's first service would otherwise shadow the other."""
+    d, srv = agent
+    c = Client(srv.base_url)
+    c.put("/service", {"vip": "10.254.3.1", "port": 80,
+                       "backends": [{"ip": "10.0.0.5", "port": 80}]})
+    c.put("/service", {"vip": "fd00::1", "port": 80,
+                       "backends": [{"ip": "fd00::5", "port": 80}]})
+    svcs = c.get("/service")
+    ids = [s["id"] for s in svcs]
+    assert len(set(ids)) == 2, ids
+    v6_id = next(s["id"] for s in svcs if ":" in s["vip"])
+    v4_id = next(s["id"] for s in svcs if ":" not in s["vip"])
+    # each id resolves to ITS family's service
+    assert ":" in c.get(f"/service/{v6_id}")["vip"]
+    assert ":" not in c.get(f"/service/{v4_id}")["vip"]
+    # deleting the v6 id removes only the v6 service
+    assert c.delete(f"/service/{v6_id}")["deleted"] == v6_id
+    remaining = c.get("/service")
+    assert len(remaining) == 1 and ":" not in remaining[0]["vip"]
