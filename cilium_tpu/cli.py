@@ -26,6 +26,20 @@ from typing import Dict, List, Optional
 DEFAULT_API = "http://127.0.0.1:9234"
 
 
+class APIError(SystemExit):
+    """Typed agent-API failure.  Subclasses SystemExit so bare CLI use
+    still exits non-zero with the message on stderr (SystemExit's
+    ``code`` stays the message — do NOT store the HTTP status there, or
+    an uncaught error would become the process exit status).
+    Programmatic callers (docker plugin, CNI) read ``.status`` to tell
+    a 404 from a 5xx or from a transport failure (status is None when
+    the agent was unreachable)."""
+
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
+
+
 class Client:
     """Tiny REST client (pkg/client analog)."""
 
@@ -49,9 +63,9 @@ class Client:
                 msg = json.loads(payload).get("error", payload.decode())
             except ValueError:
                 msg = payload.decode(errors="replace")
-            raise SystemExit(f"API error {e.code}: {msg}")
+            raise APIError(f"API error {e.code}: {msg}", status=e.code)
         except urllib.error.URLError as e:
-            raise SystemExit(
+            raise APIError(
                 f"cannot reach agent at {self.base_url}: {e.reason}")
         if raw:
             return payload.decode()

@@ -43,7 +43,7 @@ def cni_add(client: Client, container_id: str, netns: str = "",
     except SystemExit as e:
         # runtimes retry ADD; an existing endpoint is success
         # (idempotency per the CNI spec) — return its addressing
-        if "409" not in str(e):
+        if getattr(e, "status", None) != 409:
             raise
         ep = client.get(f"/endpoint/{ep_id}")
     result = {
@@ -61,8 +61,14 @@ def cni_del(client: Client, container_id: str) -> bool:
     try:
         client.delete(f"/endpoint/{ep_id}")
         return True
-    except SystemExit:
-        return False  # already gone: CNI DEL must be idempotent
+    except SystemExit as e:
+        # 404 = already gone: CNI DEL must be idempotent.  Any other
+        # failure (unreachable agent, 5xx) must propagate — reporting
+        # success would stop the runtime's retries and leak the
+        # endpoint, its IP, and its identity refcount in the agent
+        if getattr(e, "status", None) == 404:
+            return False
+        raise
 
 
 def main(argv=None) -> int:
@@ -82,7 +88,13 @@ def main(argv=None) -> int:
                                  config)))
         return 0
     if command == "DEL":
-        cni_del(client, container_id)
+        try:
+            cni_del(client, container_id)
+        except SystemExit as e:
+            # CNI error result (spec 1.0 "error" object, code 7 =
+            # generic failure): non-zero exit makes the runtime retry
+            print(json.dumps({"code": 7, "msg": str(e)}))
+            return 1
         return 0
     if command == "VERSION":
         print(json.dumps({"cniVersion": CNI_VERSION,

@@ -416,6 +416,11 @@ class Daemon:
         returns it so clients can refresh routes after a restart)."""
         if family not in ("ipv4", "ipv6"):
             raise IPAMError(f"unknown address family {family!r}")
+        # the pool object always exists for v4 (host addressing and
+        # endpoint lifecycle claims need it) but allocation honours the
+        # enable flag, matching how ipam6 is gated at construction
+        if family == "ipv4" and not self.config.enable_ipv4:
+            raise IPAMError("family 'ipv4' not enabled")
         pool = self.ipam6 if family == "ipv6" else self.ipam
         if pool is None:
             raise IPAMError(f"family {family!r} not enabled")
@@ -454,6 +459,7 @@ class Daemon:
                         f"{ipv4} already in use by {holder}")
                 # outside the pool, or a non-endpoint claim (docker
                 # flow) whose owner releases it — proceed
+        did_upsert = False
         try:
             ep = Endpoint(endpoint_id, ipv4=ipv4,
                           container_name=container_name,
@@ -469,15 +475,19 @@ class Daemon:
                 self.ipcache.upsert(ipv4, ep.security_identity,
                                     SOURCE_AGENT_LOCAL,
                                     metadata=f"endpoint:{endpoint_id}")
+                did_upsert = True
         except BaseException:
             # failed create must not strand ANY of its claims on a
             # ghost endpoint: IP, ipcache entry, device-table slot,
             # identity refcount (detach/release are no-ops for steps
-            # that never ran)
+            # that never ran).  The ipcache delete is gated on OUR
+            # upsert having happened: an out-of-pool IP that failed
+            # earlier may still be another endpoint's live mapping
             if ipv4:
                 self.ipam.release_if_owner(ipv4,
                                            f"endpoint:{endpoint_id}")
-                self.ipcache.delete(ipv4, SOURCE_AGENT_LOCAL)
+                if did_upsert:
+                    self.ipcache.delete(ipv4, SOURCE_AGENT_LOCAL)
             ghost = self.endpoints.remove(endpoint_id)
             if ghost is not None and ghost.identity is not None:
                 self.identity_allocator.release(ghost.identity)

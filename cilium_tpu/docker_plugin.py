@@ -135,8 +135,12 @@ class LibnetworkDriver:
         ep_id = endpoint_id_for(eid)
         try:
             self.client.get(f"/endpoint/{ep_id}")
-        except SystemExit:
-            pass  # not found — the expected case
+        except SystemExit as e:
+            # only a 404 means "free to create"; a 5xx or an
+            # unreachable agent must surface, not masquerade as the
+            # normal create path
+            if getattr(e, "status", None) != 404:
+                raise PluginError(f"agent lookup failed: {e}")
         else:
             raise PluginError("endpoint already exists")
         labels = [f"container:docker-endpoint={eid[:12]}"]
@@ -165,8 +169,12 @@ class LibnetworkDriver:
         ep_id = endpoint_id_for(eid)
         try:
             self.client.get(f"/endpoint/{ep_id}")
-        except SystemExit:
-            raise PluginError(f"endpoint {eid!r} not found")
+        except SystemExit as e:
+            # a transient agent failure must not read as "endpoint
+            # gone" — docker would tear down a live container
+            if getattr(e, "status", None) == 404:
+                raise PluginError(f"endpoint {eid!r} not found")
+            raise PluginError(f"agent lookup failed: {e}")
         with self._lock:
             gw6 = self.addressing.get("ipv6", {}).get("ip", "")
         return {
@@ -181,8 +189,11 @@ class LibnetworkDriver:
         ep_id = endpoint_id_for(body.get("EndpointID", ""))
         try:
             self.client.delete(f"/endpoint/{ep_id}")
-        except SystemExit:
-            pass  # already gone; Leave stays idempotent (driver.go:443)
+        except SystemExit as e:
+            # 404 = already gone; Leave stays idempotent
+            # (driver.go:443).  Anything else would leak the endpoint
+            if getattr(e, "status", None) != 404:
+                raise PluginError(f"endpoint delete failed: {e}")
         return {}
 
     def _ipam_capabilities(self, body: Dict) -> Dict:

@@ -10,10 +10,14 @@ backoff (WaitFunc).
 from __future__ import annotations
 
 import queue
+import sys
 import threading
-from typing import Callable, Optional
+from typing import Callable
 
-# WaitFunc(n_retries) -> True to retry the failed function again
+# WaitFunc(n_retries) -> True to retry the failed function again.
+# Contract: a call with a retry count the caller's budget can never
+# reach (the queue uses sys.maxsize on shutdown-discard) means "this
+# function will never run — release anything recorded for it".
 WaitFunc = Callable[[int], bool]
 
 
@@ -29,8 +33,11 @@ class FunctionQueue:
     semantics: WaitFunc returns false -> drop and move on).
     """
 
-    def __init__(self, queue_size: int = 1024, name: str = "fq"):
-        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+    def __init__(self, name: str = "fq"):
+        # unbounded: enqueue inserts while holding the _idle lock the
+        # worker needs after every function, so a blocking put on a
+        # full bounded queue would deadlock the pair
+        self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._idle = threading.Condition()
         self._pending = 0
@@ -40,11 +47,15 @@ class FunctionQueue:
 
     def enqueue(self, f: Callable[[], None],
                 wait_func: WaitFunc = no_retry) -> None:
-        if self._stop.is_set():
-            raise RuntimeError("FunctionQueue is stopped")
+        # the stop check, pending count, and queue insert share the
+        # _idle lock with stop(): without it an item slipped in after
+        # stop()'s check is never executed and wait_idle hangs on the
+        # orphaned _pending count
         with self._idle:
+            if self._stop.is_set():
+                raise RuntimeError("FunctionQueue is stopped")
             self._pending += 1
-        self._q.put((f, wait_func))
+            self._q.put((f, wait_func))
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -78,5 +89,28 @@ class FunctionQueue:
              timeout: float = 10.0) -> None:
         if drain:
             self.wait_idle(timeout)
-        self._stop.set()
+        discarded = []
+        with self._idle:
+            self._stop.set()
+            # anything still queued will never run (non-drain stop, or
+            # wait_idle timed out): drop it and zero _pending so
+            # wait_idle callers wake instead of timing out
+            while True:
+                try:
+                    discarded.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+                self._pending -= 1
+            if self._pending <= 0:
+                self._idle.notify_all()
+        # tell each dropped item's wait_func via the give-up call so
+        # callers can roll back bookkeeping they did at enqueue time
+        # (the k8s watcher un-records the event's resourceVersion on
+        # this path).  Outside the _idle lock: wait_funcs take caller
+        # locks whose holders may be blocked on _idle in enqueue()
+        for _f, wait in discarded:
+            try:
+                wait(sys.maxsize)
+            except Exception:  # noqa: BLE001 — discard must finish
+                pass
         self._thread.join(timeout=2.0)
