@@ -480,16 +480,22 @@ class SocketProxy:
                     method, path, _version = request_line.split(" ", 2)
                 except ValueError:
                     raise ConnectionResetError("bad request line")
-                if "chunked" in headers.get("transfer-encoding", ""):
-                    # not framed here; fail closed rather than smuggle
-                    raise ConnectionResetError("chunked not supported")
-                body_len = _content_length(headers)
-                while len(buf) < body_len:
-                    chunk = await client_r.read(65536)
-                    if not chunk:
-                        raise ConnectionResetError("truncated body")
-                    buf += chunk
-                body, buf = buf[:body_len], buf[body_len:]
+                chunked = False
+                te = headers.get("transfer-encoding")
+                if te is not None:
+                    # the only encoding framed here is a bare final
+                    # "chunked"; anything stacked ("gzip, chunked") or
+                    # unknown is a framing ambiguity -> fail closed.
+                    # TE+CL together is the classic TE.CL smuggling
+                    # split-brain (RFC 7230 3.3.3): reset, never pick
+                    # one side
+                    if te.strip().lower() != "chunked":
+                        raise ConnectionResetError(
+                            "unsupported transfer-encoding")
+                    if "content-length" in headers:
+                        raise ConnectionResetError(
+                            "content-length with chunked")
+                    chunked = True
                 req = HTTPRequest(method=method, path=path,
                                   host=headers.get("host", ""),
                                   headers=dict(headers))
@@ -497,17 +503,55 @@ class SocketProxy:
                     else True
                 info = {"method": method, "path": path,
                         "host": headers.get("host", "")}
-                if allowed:
-                    up_w.write(raw_head + body)
-                    await up_w.drain()
-                    self._log(ctx, "forwarded", "http", src_id, dst_id,
-                              info)
-                else:
+                if not allowed:
                     client_w.write(HTTP_DENY)
                     await client_w.drain()
                     self._log(ctx, "denied", "http", src_id, dst_id,
                               info)
+                    # consume the remainder of the denied request's
+                    # body (bounded) so the close is a clean FIN:
+                    # closing with unread bytes in the receive buffer
+                    # RSTs, and an RST can discard the 403 before the
+                    # client reads it
+                    try:
+                        if chunked:
+                            await _forward_chunked(
+                                client_r, buf, _DISCARD,
+                                max_bytes=DENY_DRAIN_MAX)
+                        else:
+                            remaining = _content_length(headers) \
+                                - len(buf)
+                            allowance = DENY_DRAIN_MAX
+                            while remaining > 0 and allowance > 0:
+                                chunk = await client_r.read(
+                                    min(65536, remaining))
+                                if not chunk:
+                                    break
+                                remaining -= len(chunk)
+                                allowance -= len(chunk)
+                    except ConnectionResetError:
+                        pass
                     raise ConnectionResetError("denied: close")
+                if chunked:
+                    # forward the verified head, then re-frame the body
+                    # chunk by chunk: upstream only ever sees bytes this
+                    # proxy serialized itself, so its framing cannot
+                    # diverge from the one the policy check used
+                    up_w.write(raw_head)
+                    buf = await _forward_chunked(client_r, buf, up_w)
+                    await up_w.drain()
+                else:
+                    body_len = _content_length(headers)
+                    while len(buf) < body_len:
+                        chunk = await client_r.read(65536)
+                        if not chunk:
+                            raise ConnectionResetError("truncated body")
+                        buf += chunk
+                    body, buf = buf[:body_len], buf[body_len:]
+                    up_w.write(raw_head + body)
+                    await up_w.drain()
+                self._log(ctx, "forwarded", "http", src_id, dst_id,
+                          info)
             try:
                 up_w.write_eof()
             except OSError:
@@ -559,6 +603,131 @@ async def _read_kafka_frame(reader: asyncio.StreamReader,
             return None, buf
         buf += chunk
     return buf[:total], buf[total:]
+
+
+_HEX_DIGITS = frozenset(b"0123456789abcdefABCDEF")
+# RFC 7230 token charset, for strict trailer-field-name validation
+_TOKEN_CHARS = frozenset(
+    b"!#$%&'*+-.^_`|~0123456789"
+    b"ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz")
+MAX_CHUNK_BYTES = 64 << 20
+MAX_TRAILER_LINES = 32
+# how much of a denied request's body the proxy will read off the wire
+# to deliver the 403 over a clean FIN before giving up and resetting
+DENY_DRAIN_MAX = 4 << 20
+
+
+async def _read_crlf_line(reader: asyncio.StreamReader, buf: bytes,
+                          limit: int = 8192) -> Tuple[bytes, bytes]:
+    """One CRLF-terminated line (line without CRLF, leftover).  A bare
+    LF is NOT accepted as a terminator: lenient line endings are
+    exactly the parser disagreement smuggling rides on."""
+    while b"\r\n" not in buf:
+        if len(buf) > limit:
+            raise ConnectionResetError("oversized line")
+        chunk = await reader.read(65536)
+        if not chunk:
+            raise ConnectionResetError("truncated chunked body")
+        buf += chunk
+    line, rest = buf.split(b"\r\n", 1)
+    if len(line) > limit:
+        raise ConnectionResetError("oversized line")
+    return line, rest
+
+
+class _DiscardSink:
+    """Writer-shaped null sink for draining a denied request's body."""
+
+    def write(self, _data) -> None:
+        pass
+
+    async def drain(self) -> None:
+        pass
+
+
+_DISCARD = _DiscardSink()
+
+
+async def _forward_chunked(reader: asyncio.StreamReader, buf: bytes,
+                           up_w, max_bytes: Optional[int] = None
+                           ) -> bytes:
+    """Strictly parse one chunked request body and forward a canonical
+    re-serialization (the reference rides Envoy's codec, which frames
+    chunked bodies the same way: envoy/cilium_l7policy.cc:127 only ever
+    sees codec-framed requests).  Fail-closed rules:
+
+    - chunk-size line: 1-16 hex digits, nothing else — chunk
+      extensions (``;name=value``) are rejected outright, as are
+      signs, whitespace, and bare-LF line endings;
+    - every chunk's data must be followed by exactly CRLF;
+    - trailers after the 0-chunk are strictly parsed (token ``:``
+      value), bounded, and DISCARDED — framing- or routing-critical
+      fields arriving after the policy check can never reach upstream.
+
+    Chunk data is streamed upstream in read-sized pieces once its size
+    line is validated (no per-chunk buffering — a chunk may be up to
+    MAX_CHUNK_BYTES).  A framing violation discovered mid-chunk resets
+    the connection, leaving upstream with an unterminated body it can
+    never mistake for a complete request.
+
+    ``max_bytes`` bounds the total body (used by the deny-path drain
+    into ``_DISCARD``); exceeding it resets.  Returns the leftover
+    bytes after the body (pipelined next request).
+    """
+    total = 0
+    while True:
+        line, buf = await _read_crlf_line(reader, buf, limit=32)
+        if not line or len(line) > 16 or \
+                any(c not in _HEX_DIGITS for c in line):
+            raise ConnectionResetError("bad chunk size")
+        size = int(line, 16)
+        if size > MAX_CHUNK_BYTES:
+            raise ConnectionResetError("oversized chunk")
+        if size == 0:
+            break
+        total += size
+        if max_bytes is not None and total > max_bytes:
+            raise ConnectionResetError("chunked body over budget")
+        up_w.write(b"%x\r\n" % size)
+        remaining = size
+        take = min(len(buf), remaining)
+        if take:
+            up_w.write(buf[:take])
+            buf = buf[take:]
+            remaining -= take
+        while remaining:
+            chunk = await reader.read(min(65536, remaining))
+            if not chunk:
+                raise ConnectionResetError("truncated chunk")
+            up_w.write(chunk)
+            remaining -= len(chunk)
+            await up_w.drain()
+        while len(buf) < 2:
+            chunk = await reader.read(65536)
+            if not chunk:
+                raise ConnectionResetError("truncated chunk")
+            buf += chunk
+        if buf[:2] != b"\r\n":
+            raise ConnectionResetError("chunk data not CRLF-terminated")
+        up_w.write(b"\r\n")
+        buf = buf[2:]
+        await up_w.drain()
+    # trailer section: zero or more strict header lines, then empty line
+    for _ in range(MAX_TRAILER_LINES + 1):
+        line, buf = await _read_crlf_line(reader, buf)
+        if not line:
+            break
+        name, sep, _value = line.partition(b":")
+        if not sep or not name or \
+                any(c not in _TOKEN_CHARS for c in name):
+            raise ConnectionResetError("bad trailer line")
+        if name.lower() in (b"content-length", b"transfer-encoding",
+                            b"host"):
+            raise ConnectionResetError("framing header in trailers")
+    else:
+        raise ConnectionResetError("too many trailer lines")
+    up_w.write(b"0\r\n\r\n")
+    return buf
 
 
 def _content_length(headers: Dict[str, str]) -> int:

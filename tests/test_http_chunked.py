@@ -1,0 +1,296 @@
+"""Chunked transfer-encoding in the HTTP proxy (round-5 VERDICT #4).
+
+The reference's L7 HTTP path sits on Envoy's full codec, which frames
+chunked bodies before cilium_l7policy.cc:127 ever sees a request.
+Rounds 1-4 failed the connection closed on ANY chunked request; this
+matrix pins the new behavior: legal chunked bodies are strictly framed
+and re-serialized, while every ambiguous form still fails closed.
+"""
+
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.l7.http import HTTPPolicyEngine
+from cilium_tpu.l7.socket_proxy import ListenerContext, SocketProxy
+from cilium_tpu.policy.api import PortRuleHTTP
+from cilium_tpu.proxy import AccessLog
+
+
+class _Upstream(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, handler_fn=lambda data: None):
+        self.received = []
+        self.handler_fn = handler_fn
+        super().__init__(("127.0.0.1", 0), _UpHandler)
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+    @property
+    def port(self):
+        return self.server_address[1]
+
+
+class _UpHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                data = self.request.recv(65536)
+            except OSError:
+                return
+            if not data:
+                return
+            self.server.received.append(data)
+            reply = self.server.handler_fn(data)
+            if reply:
+                self.request.sendall(reply)
+
+
+def _connect(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.settimeout(5)
+    return s
+
+
+def _drain(sock, timeout=2):
+    deadline = time.time() + timeout
+    sock.settimeout(0.2)
+    buf = b""
+    while time.time() < deadline:
+        try:
+            chunk = sock.recv(65536)
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+@pytest.fixture()
+def proxy():
+    sp = SocketProxy(access_log=AccessLog())
+    yield sp
+    sp.shutdown()
+
+
+def _ctx(upstream, paths="/public/.*"):
+    engine = HTTPPolicyEngine([PortRuleHTTP(path=paths)])
+    return ListenerContext(
+        redirect_id="r:ingress:TCP:80", parser_type="http",
+        orig_dst=lambda peer: ("127.0.0.1", upstream.port),
+        http_engine_for=lambda peer: engine)
+
+
+def _wait_upstream(upstream, needle, timeout=3):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if needle in b"".join(upstream.received):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+HEAD_CHUNKED = (b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n")
+
+
+def test_valid_chunked_request_forwarded(proxy):
+    ok = b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok"
+    upstream = _Upstream(lambda data: ok if b"0\r\n\r\n" in data else None)
+    port = proxy.start_listener(0, _ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(HEAD_CHUNKED +
+                  b"5\r\nhello\r\n6\r\n world\r\n0\r\n\r\n")
+        got = _drain(c)
+    finally:
+        c.close()
+        upstream.shutdown()
+    blob = b"".join(upstream.received)
+    assert b"POST /public/a" in blob
+    # body arrives re-framed with the same content
+    assert b"5\r\nhello\r\n" in blob and b"6\r\n world\r\n" in blob
+    assert blob.endswith(b"0\r\n\r\n")
+    assert b"200 OK" in got
+
+
+def test_chunked_split_across_packets(proxy):
+    """Chunk size line, data, and terminator arriving byte-dribbled."""
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    c = _connect(port)
+    try:
+        wire = HEAD_CHUNKED + b"b\r\nhello world\r\n0\r\n\r\n"
+        for i in range(0, len(wire), 7):
+            c.sendall(wire[i:i + 7])
+            time.sleep(0.005)
+        assert _wait_upstream(upstream, b"0\r\n\r\n")
+    finally:
+        c.close()
+        upstream.shutdown()
+    assert b"b\r\nhello world\r\n" in b"".join(upstream.received)
+
+
+def test_te_cl_conflict_fails_closed(proxy):
+    """TE.CL split-brain: an upstream honoring CL=4 would treat the
+    smuggled request as a new pipelined one.  Must reset, never pick."""
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                  b"Content-Length: 4\r\n"
+                  b"Transfer-Encoding: chunked\r\n\r\n"
+                  b"0\r\n\r\nGET /secret HTTP/1.1\r\n\r\n")
+        _drain(c)
+    finally:
+        c.close()
+        upstream.shutdown()
+    assert not upstream.received
+
+
+def test_stacked_transfer_encoding_fails_closed(proxy):
+    """"gzip, chunked" and obfuscated values are parser-dependent."""
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    for te in (b"gzip, chunked", b"xchunked", b"chunked, identity",
+               b"chu\tnked"):
+        c = _connect(port)
+        try:
+            c.sendall(b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                      b"Transfer-Encoding: " + te + b"\r\n\r\n"
+                      b"0\r\n\r\n")
+            _drain(c, timeout=0.8)
+        finally:
+            c.close()
+    upstream.shutdown()
+    assert not upstream.received
+
+
+def test_malformed_chunk_size_fails_closed(proxy):
+    """Signs, whitespace, extensions, and overlong sizes in the
+    chunk-size line all reset; the pipelined follow-up never leaks."""
+    for bad in (b"+5", b"5;ext=1", b" 5", b"5 ", b"0x5", b"",
+                b"ffffffffffffffffff", b"5\n"):
+        upstream = _Upstream()
+        port = proxy.start_listener(0, _ctx(upstream))
+        c = _connect(port)
+        try:
+            c.sendall(HEAD_CHUNKED + bad + b"\r\nhello\r\n0\r\n\r\n"
+                      b"GET /secret HTTP/1.1\r\n\r\n")
+            _drain(c, timeout=0.8)
+        finally:
+            c.close()
+            proxy.stop_listener("r:ingress:TCP:80")
+            upstream.shutdown()
+        blob = b"".join(upstream.received)
+        assert b"secret" not in blob, bad
+
+
+def test_chunk_data_missing_crlf_fails_closed(proxy):
+    """Chunk data must be followed by exactly CRLF; a bare LF (or
+    overlong data) is the disagreement smuggling rides on."""
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(HEAD_CHUNKED + b"5\r\nhelloXX"
+                  b"GET /secret HTTP/1.1\r\n\r\n")
+        _drain(c, timeout=0.8)
+    finally:
+        c.close()
+        upstream.shutdown()
+    assert b"secret" not in b"".join(upstream.received)
+
+
+def test_valid_trailers_strictly_parsed_and_discarded(proxy):
+    """Legal trailers don't kill the exchange but are not forwarded:
+    fields arriving after the policy check can't reach upstream."""
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(HEAD_CHUNKED + b"2\r\nhi\r\n0\r\n"
+                  b"X-Checksum: abc123\r\n\r\n")
+        assert _wait_upstream(upstream, b"0\r\n\r\n")
+    finally:
+        c.close()
+        upstream.shutdown()
+    blob = b"".join(upstream.received)
+    assert b"2\r\nhi\r\n" in blob
+    assert b"X-Checksum" not in blob
+
+
+def test_framing_header_in_trailers_fails_closed(proxy):
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(HEAD_CHUNKED + b"2\r\nhi\r\n0\r\n"
+                  b"Content-Length: 99\r\n\r\n"
+                  b"GET /secret HTTP/1.1\r\n\r\n")
+        _drain(c, timeout=0.8)
+    finally:
+        c.close()
+        upstream.shutdown()
+    assert b"secret" not in b"".join(upstream.received)
+
+
+def test_malformed_trailer_line_fails_closed(proxy):
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    for trailer in (b"no-colon-here", b": empty-name", b"sp ace: v"):
+        c = _connect(port)
+        try:
+            c.sendall(HEAD_CHUNKED + b"2\r\nhi\r\n0\r\n"
+                      + trailer + b"\r\n\r\n"
+                      b"GET /secret HTTP/1.1\r\n\r\n")
+            _drain(c, timeout=0.8)
+        finally:
+            c.close()
+    upstream.shutdown()
+    assert b"secret" not in b"".join(upstream.received)
+
+
+def test_denied_chunked_request_never_reaches_upstream(proxy):
+    """The policy check runs on the head before any body byte is
+    forwarded; a denied chunked POST leaves upstream untouched."""
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(b"POST /secret HTTP/1.1\r\nHost: h\r\n"
+                  b"Transfer-Encoding: chunked\r\n\r\n"
+                  b"5\r\nhello\r\n0\r\n\r\n")
+        got = _drain(c)
+    finally:
+        c.close()
+        upstream.shutdown()
+    assert b"403" in got
+    assert not upstream.received
+
+
+def test_pipelined_request_after_chunked_body_is_policy_checked(proxy):
+    """Bytes after a valid chunked body are the NEXT request, not body
+    spill: a denied pipelined request must not leak upstream."""
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    c = _connect(port)
+    try:
+        c.sendall(HEAD_CHUNKED + b"5\r\nhello\r\n0\r\n\r\n"
+                  b"GET /secret HTTP/1.1\r\nHost: h\r\n\r\n")
+        got = _drain(c)
+    finally:
+        c.close()
+        upstream.shutdown()
+    blob = b"".join(upstream.received)
+    assert b"POST /public/a" in blob
+    assert b"secret" not in blob
+    assert b"403" in got
