@@ -11,6 +11,7 @@ the device tables (same packed keys, same hash).
 
 from __future__ import annotations
 
+import ctypes
 import threading
 from typing import Dict, Optional
 
@@ -23,15 +24,45 @@ from . import VerdictCache, load
 VERDICT_DROP = -1
 
 
+class _Scratch:
+    """Preallocated request/response buffers + their ctypes pointers.
+
+    Creating a ``ctypes`` POINTER object per array per call costs
+    ~2µs each with multi-µs p99 outliers — measured as the dominant
+    term of the classify path (5 pointer wraps ≈ 11µs p50 / 34µs p99
+    at b256 on this box, vs 3.2µs/8.9µs for the native call itself).
+    Wrapping the pointers ONCE and memcpy-ing inputs into pinned
+    buffers (4×1KiB at b256) buys the <50µs p99 target its structural
+    margin."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.ident = np.empty(cap, np.uint32)
+        self.dport = np.empty(cap, np.int32)
+        self.proto = np.empty(cap, np.int32)
+        self.dirn = np.empty(cap, np.int32)
+        self.out = np.empty(cap, np.int32)
+        p_i32 = ctypes.POINTER(ctypes.c_int32)
+        p_u32 = ctypes.POINTER(ctypes.c_uint32)
+        self.p_ident = self.ident.ctypes.data_as(p_u32)
+        self.p_dport = self.dport.ctypes.data_as(p_i32)
+        self.p_proto = self.proto.ctypes.data_as(p_i32)
+        self.p_dirn = self.dirn.ctypes.data_as(p_i32)
+        self.p_out = self.out.ctypes.data_as(p_i32)
+
+
 class HostVerdictPath:
     """Per-endpoint C++ verdict caches + batched 3-stage evaluation."""
 
-    def __init__(self, slots_per_endpoint: int = 1 << 14):
-        load()  # force the native build NOW so callers' optional-probe
-        #         try/except actually engages when g++/dlopen fails
+    def __init__(self, slots_per_endpoint: int = 1 << 14,
+                 scratch_batch: int = 4096):
+        # force the native build NOW so callers' optional-probe
+        # try/except actually engages when g++/dlopen fails
+        self._lib = load()
         self.slots = slots_per_endpoint
         self._lock = threading.Lock()
         self._caches: Dict[int, VerdictCache] = {}
+        self._scratch = _Scratch(scratch_batch)
 
     def sync_endpoint(self, endpoint_id: int,
                       state: PolicyMapState) -> None:
@@ -67,11 +98,27 @@ class HostVerdictPath:
         The whole exact -> L3-only -> L4-wildcard fallback runs in ONE
         native call (vc_classify_batch): one lock acquisition, zero
         per-stage Python/numpy round trips, which is what keeps the
-        small-batch latency under the device round trip."""
+        small-batch latency under the device round trip.  Batches up
+        to ``scratch_batch`` go through preallocated buffers with
+        pre-wrapped ctypes pointers (see _Scratch); the lock is held
+        across the native call so the shared scratch (and the cache
+        swap in sync_endpoint) stay race-free — uncontended acquire is
+        ~0.1µs, three orders under the pointer-wrapping it replaces."""
+        n = len(identity)
+        s = self._scratch
         with self._lock:
             cache = self._caches.get(endpoint_id)
-        if cache is None:
-            return None
+            if cache is None:
+                return None
+            if n <= s.cap:
+                s.ident[:n] = identity
+                s.dport[:n] = dport
+                s.proto[:n] = proto
+                s.dirn[:n] = direction
+                self._lib.vc_classify_batch(
+                    cache._h, s.p_ident, s.p_dport, s.p_proto,
+                    s.p_dirn, n, s.p_out)
+                return s.out[:n].copy()
         return cache.classify_batch(identity, dport, proto, direction)
 
     def stats(self) -> Dict[int, Dict]:
