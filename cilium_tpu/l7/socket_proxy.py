@@ -771,11 +771,18 @@ async def _read_http_head(reader: asyncio.StreamReader, buf: bytes):
     lines = head.decode("latin1").split("\r\n")
     headers = {}
     for line in lines[1:]:
-        if ":" in line:
-            k, v = line.split(":", 1)
-            key = k.strip().lower()
-            if key in headers and key in ("content-length",
-                                          "transfer-encoding"):
-                raise ConnectionResetError(f"duplicate {key}")
-            headers[key] = v.strip()
+        # every head line must be a plain `name: value` — obs-fold
+        # continuations (leading SP/HTAB) and colon-less lines are
+        # rejected, NOT skipped: raw_head is forwarded verbatim, so a
+        # line this parser ignores but the upstream honors (e.g. a
+        # folded "\tgzip" extending Transfer-Encoding) would desync
+        # the two framings (request smuggling)
+        if line[:1] in (" ", "\t") or ":" not in line:
+            raise ConnectionResetError("malformed header line")
+        k, v = line.split(":", 1)
+        key = k.strip().lower()
+        if key in headers and key in ("content-length",
+                                      "transfer-encoding"):
+            raise ConnectionResetError(f"duplicate {key}")
+        headers[key] = v.strip()
     return (lines[0], headers, head + b"\r\n\r\n"), rest

@@ -174,6 +174,27 @@ def test_stacked_transfer_encoding_fails_closed(proxy):
     assert not upstream.received
 
 
+def test_obs_fold_header_fails_closed(proxy):
+    """A folded continuation line ("\\tgzip") that this parser ignored
+    but raw_head carried verbatim would let an upstream honoring
+    obs-fold read 'Transfer-Encoding: chunked gzip' — framing desync.
+    Any folded or colon-less head line must reset."""
+    upstream = _Upstream()
+    port = proxy.start_listener(0, _ctx(upstream))
+    for head in (b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                 b"Transfer-Encoding: chunked\r\n\tgzip\r\n\r\n",
+                 b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                 b"Content-Length: 5\r\n colon-less junk\r\n\r\n"):
+        c = _connect(port)
+        try:
+            c.sendall(head + b"0\r\n\r\nGET /secret HTTP/1.1\r\n\r\n")
+            _drain(c, timeout=0.8)
+        finally:
+            c.close()
+    upstream.shutdown()
+    assert not upstream.received
+
+
 def test_malformed_chunk_size_fails_closed(proxy):
     """Signs, whitespace, extensions, and overlong sizes in the
     chunk-size line all reset; the pipelined follow-up never leaks."""
