@@ -466,7 +466,9 @@ def cmd_agent(args) -> int:
 
     cfg = DaemonConfig(cluster_name=args.cluster_name,
                        cluster_id=args.cluster_id,
-                       state_dir=args.state_dir)
+                       state_dir=args.state_dir,
+                       ct_checkpoint_interval_s=getattr(
+                           args, "ct_checkpoint_interval", 10.0))
     kv = None
     if args.kvstore and args.kvstore != "none":
         kv = setup_client(args.kvstore)
@@ -655,6 +657,9 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("--cluster-id", type=int, default=0)
     ag.add_argument("--node-name", default="node-local")
     ag.add_argument("--state-dir", default="")
+    ag.add_argument("--ct-checkpoint-interval", type=float, default=10.0,
+                    help="seconds between CT snapshots to state-dir "
+                         "(0 = only at clean shutdown)")
     return p
 
 

@@ -178,6 +178,14 @@ class Daemon:
         self.controllers.update_controller(
             "ct-gc", ControllerParams(
                 do_func=lambda: self.datapath.gc(), run_interval=5.0))
+        # periodic CT checkpoint: a kill -9'd agent otherwise loses
+        # every established flow (shutdown() is the only other writer)
+        if self.config.state_dir and \
+                self.config.ct_checkpoint_interval_s > 0:
+            self.controllers.update_controller(
+                "ct-checkpoint", ControllerParams(
+                    do_func=self.checkpoint_ct,
+                    run_interval=self.config.ct_checkpoint_interval_s))
 
     # ------------------------------------------------------------ nodes
 

@@ -212,6 +212,11 @@ class DaemonConfig:
     proxy_port_min: int = 10000        # reference: daemon.go:1326
     proxy_port_max: int = 20000
     ct_slots: int = 1 << 16
+    # periodic CT snapshot interval (0 disables).  The reference's CT
+    # lives in pinned bpffs maps that survive agent death for free
+    # (SURVEY §5 checkpoint/resume); a periodic snapshot is the analog
+    # that lets a SIGKILLed agent restart with its established flows.
+    ct_checkpoint_interval_s: float = 10.0
     monitor_queue_size: int = 4096
     kvstore: str = "memory"
     kvstore_opts: Dict[str, str] = field(default_factory=dict)
