@@ -40,6 +40,7 @@ from ..labels import Labels
 from ..monitor import MonitorHub
 from ..node import Node, NodeManager, NodeRegistry
 from ..policy.api import Rule
+from ..policy.mapstate import PolicyMapState
 from ..policy.repository import Repository
 from ..policy.trace import SearchContext, traced_context
 from ..proxy import ProxyManager
@@ -593,7 +594,7 @@ class Daemon:
         if not state_dir or not os.path.isdir(state_dir):
             return 0
         self.restore_ct()
-        n = 0
+        restored = []
         for fname in sorted(os.listdir(state_dir)):
             if not (fname.startswith("ep_") and fname.endswith(".json")):
                 continue
@@ -624,9 +625,44 @@ class Daemon:
                     # outside this node's range (config changed) or
                     # already claimed — either way not double-bookable
                     pass
-            self.endpoints.queue_regeneration(ep.id)
-            n += 1
-        return n
+            restored.append((ep, snap.get("identity")))
+        # Pinned-map parity (daemon/state.go + bpffs pinned maps: the
+        # dataplane keeps enforcing the OLD policy while the agent is
+        # down and until fresh policy arrives).  If every restored
+        # endpoint's re-resolved identity matches its checkpoint — the
+        # allocator reproduced the identity universe, which a
+        # kvstore-backed allocator guarantees and the local one gives
+        # deterministically for an unchanged endpoint set — realize the
+        # checkpointed verdict state directly: allowed flows keep
+        # flowing BEFORE the orchestrator re-imports policy, and denied
+        # ones stay denied.  Any mismatch means numeric identities in
+        # the snapshots may now name different workloads, so fail
+        # closed: queue regenerations against the (empty) repo instead,
+        # which drops new flows until policy import.  The next
+        # policy_add regenerates everything either way.
+        stable = all(ck is not None and ep.security_identity == ck
+                     for ep, ck in restored)
+        for ep, _ck in restored:
+            if stable:
+                # L7 redirect entries are scrubbed, not restored: their
+                # proxy_port names a listener of the DEAD agent's proxy
+                # child (gone, or worse re-bound by someone else).
+                # Those flows fail closed until policy re-import
+                # re-creates redirects on live ports; plain L3/L4
+                # allows restore verbatim.
+                scrubbed = PolicyMapState(
+                    {k: v for k, v in ep.realized.items()
+                     if v.proxy_port == 0})
+                ep.realized = scrubbed
+                if self.host_path is not None:
+                    self.host_path.sync_endpoint(ep.id, scrubbed)
+                self.table_mgr.sync_endpoint(ep.id, scrubbed,
+                                             ep.policy_revision)
+            else:
+                self.endpoints.queue_regeneration(ep.id)
+        if stable and restored:
+            self.datapath.refresh_policy()
+        return len(restored)
 
     # -------------------------------------------------- services / lb
 

@@ -119,8 +119,14 @@ def main() -> None:
     child = ProxyChild(xds_port)
     child.start()
     print(f"READY {os.getpid()}", flush=True)
-    while True:
-        time.sleep(3600)
+    # crash-only: when the agent's stream dies (agent crash/restart),
+    # this child would otherwise serve stale policy forever AND hold
+    # the proxy ports against the successor agent's child (EADDRINUSE).
+    # Exit instead; the supervisor respawns against the live agent.
+    # (Deliberate divergence from Envoy's serve-last-known-good: a
+    # short L7 outage over indefinitely stale enforcement.)
+    child.client.wait_disconnected()
+    os._exit(1)
 
 
 if __name__ == "__main__":

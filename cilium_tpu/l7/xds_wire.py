@@ -166,6 +166,16 @@ class XDSWireClient:
             raise TimeoutError(f"subscribe({type_url}) unconfirmed")
 
     def _read_loop(self):
+        try:
+            self._read_loop_inner()
+        finally:
+            # ANY exit — including an unexpected exception on a
+            # malformed frame — must wake wait_disconnected(), or the
+            # proxy child would serve stale policy forever while
+            # holding its ports against the successor's child
+            self._closed.set()
+
+    def _read_loop_inner(self):
         while not self._closed.is_set():
             try:
                 msg = recv_frame(self._sock)
@@ -195,7 +205,13 @@ class XDSWireClient:
                             "detail": detail}, self._wlock)
             except OSError:
                 break
-        self._closed.set()
+
+    def wait_disconnected(self, timeout: "float | None" = None) -> bool:
+        """Block until the stream is gone (server died, close()).  The
+        proxy child's crash-only hook: without the agent's stream it
+        would serve stale policy and hold its ports against the
+        successor child, so it exits and lets the supervisor respawn."""
+        return self._closed.wait(timeout)
 
     def close(self) -> None:
         self._closed.set()

@@ -1,0 +1,61 @@
+"""Subprocess agent for the process-level chaos test.
+
+Runs the real agent entrypoint (cli.cmd_agent path: Daemon + APIServer +
+VerdictService + restore) on ephemeral ports and prints ONE JSON line
+with the bound ports so the parent test can drive REST + verdict
+traffic, kill -9 this process mid-flight, and start a successor on the
+same state dir.
+
+Usage: python tests/chaos_agent_proc.py <state_dir> <ct_ckpt_interval>
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from cilium_tpu.daemon import Daemon  # noqa: E402
+from cilium_tpu.daemon.rest import APIServer  # noqa: E402
+from cilium_tpu.l7.supervisor import ProxySupervisor  # noqa: E402
+from cilium_tpu.utils.option import DaemonConfig  # noqa: E402
+from cilium_tpu.verdict_service import VerdictService  # noqa: E402
+
+
+def main() -> None:
+    state_dir = sys.argv[1]
+    ckpt_interval = float(sys.argv[2]) if len(sys.argv) > 2 else 0.2
+
+    cfg = DaemonConfig(state_dir=state_dir,
+                       ct_checkpoint_interval_s=ckpt_interval)
+    d = Daemon(config=cfg)
+    restored = d.restore_endpoints()
+    server = APIServer(d, port=0).start()
+    vsvc = VerdictService(d.datapath).start()
+    # the full L7 composition: xDS wire + supervised proxy child.  The
+    # child binds the redirect listeners; when THIS process is killed,
+    # the xDS stream dies, the orphan child exits (crash-only), and the
+    # successor agent's child re-binds the ports.
+    xds = d.serve_xds(port=0)
+    sup = ProxySupervisor(xds.port, backoff_base=0.2).start()
+    print(json.dumps({"api_port": server.port,
+                      "verdict_port": vsvc.port,
+                      "xds_port": xds.port,
+                      "proxy_child_pid": sup.pid,
+                      "restored": restored,
+                      "pid": os.getpid()}), flush=True)
+    # the parent kills -9; nothing here runs a clean shutdown on
+    # purpose — surviving state must come from checkpoints alone
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,252 @@
+"""Process-level chaos: kill -9 the agent under live verdict traffic.
+
+The test/runtime/chaos.go analog composed from the round-4/5 pieces:
+a REAL agent process (Daemon + REST + verdict service + periodic CT
+checkpoints) serves verdict-service batches while a traffic thread
+hammers it; the test SIGKILLs the agent mid-flight, restarts it on the
+same state dir (the supervisor role), and asserts:
+
+- zero wrong-allows at ANY point: a denied tuple never classifies as
+  allowed — before the kill, during the dead window (connection
+  errors, fine — closed is not open), or after restore;
+- the established flow survives the kill via the periodic CT
+  checkpoint (pinned-ctmap analog) — its non-SYN packets still forward
+  after restart with no policy re-imported;
+- pinned-map parity: a FRESH allowed flow also forwards after restore,
+  before any policy re-import, because the checkpointed realized
+  policy state is realized directly when the identity universe
+  reproduced (daemon/state.go + bpffs semantics);
+- after the orchestrator re-imports policy, the system converges and
+  the L7 redirect (port 80 -> proxy) is re-established with a live
+  listener.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.cli import Client
+from cilium_tpu.compiler.lpm import ipv4_to_u32
+from cilium_tpu.native import PKT_HEADER_DTYPE
+from cilium_tpu.verdict_service import VerdictClient, VerdictServiceError
+
+AGENT = os.path.join(os.path.dirname(__file__), "chaos_agent_proc.py")
+
+WEB_IP, DB_IP = "10.0.0.21", "10.0.0.22"
+SYN, ACK = 0x02, 0x10
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"id": "db"}},
+    "ingress": [
+        {"fromEndpoints": [{"matchLabels": {"id": "web"}}],
+         "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+        {"toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}],
+                      "rules": {"http": [
+                          {"method": "GET", "path": "/public.*"}]}}]},
+    ],
+    "labels": ["k8s:policy=chaos"],
+}]
+
+
+def _spawn(state_dir):
+    proc = subprocess.Popen(
+        [sys.executable, AGENT, str(state_dir), "0.2"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("agent subprocess died before reporting ports")
+    return proc, json.loads(line)
+
+
+def _recs(slot, sport, dport, flags=SYN, saddr=WEB_IP):
+    recs = np.zeros(1, PKT_HEADER_DTYPE)
+    recs["endpoint"] = slot
+    recs["saddr"] = ipv4_to_u32(saddr)
+    recs["daddr"] = ipv4_to_u32(DB_IP)
+    recs["sport"] = sport
+    recs["dport"] = dport
+    recs["proto"] = 6
+    recs["direction"] = 0
+    recs["tcp_flags"] = flags
+    recs["length"] = 100
+    return recs
+
+
+def _wait_verdict(vc, slot, dport, want_allow, timeout=60, base=51000):
+    """Poll with FRESH source ports until the verdict matches."""
+    deadline = time.time() + timeout
+    k = 0
+    while time.time() < deadline:
+        v, _ = vc.classify(_recs(slot, base + (k % 9000), dport))
+        if (int(v[0]) >= 0) == want_allow:
+            return True
+        k += 1
+        time.sleep(0.05)
+    return False
+
+
+def test_kill9_under_traffic_restores_without_wrong_allows(tmp_path):
+    state = tmp_path / "state"
+    proc, info = _spawn(state)
+    proc2 = None
+    stop = threading.Event()
+    wrong_allows = []
+    ports = {"verdict": info["verdict_port"]}
+    try:
+        c = Client(f"http://127.0.0.1:{info['api_port']}")
+        c.put("/endpoint/1", {"ipv4": WEB_IP, "labels": ["k8s:id=web"]})
+        c.put("/endpoint/2", {"ipv4": DB_IP, "labels": ["k8s:id=db"]})
+        c.request("PUT", "/policy", RULES)
+        slot = c.get("/endpoint/2")["table-slot"]
+
+        vc = VerdictClient("127.0.0.1", ports["verdict"], timeout=120)
+        assert _wait_verdict(vc, slot, 5432, True), "policy never applied"
+        v, _ = vc.classify(_recs(slot, 50001, 9999))
+        assert int(v[0]) < 0, "denied port allowed before chaos"
+
+        # the long-lived flow: SYN establishes CT, ACKs ride it
+        v, _ = vc.classify(_recs(slot, 47001, 5432, SYN))
+        assert int(v[0]) >= 0
+        v, _ = vc.classify(_recs(slot, 47001, 5432, ACK))
+        assert int(v[0]) >= 0
+        established_at = time.time()
+
+        def traffic():
+            client = None
+            k = 0
+            while not stop.is_set():
+                try:
+                    if client is None:
+                        client = VerdictClient("127.0.0.1",
+                                               ports["verdict"],
+                                               timeout=10)
+                    v, _ = client.classify(
+                        _recs(slot, 48000 + (k % 8000), 9999, SYN))
+                    if int(v[0]) >= 0:
+                        wrong_allows.append(("fresh-denied-allowed", k))
+                    v, _ = client.classify(
+                        _recs(slot, 47001, 5432, ACK))
+                except (VerdictServiceError, OSError,
+                        ConnectionError, socket.timeout):
+                    # the dead window: connections fail CLOSED —
+                    # reconnect against whatever port is current
+                    if client is not None:
+                        try:
+                            client.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        client = None
+                    stop.wait(0.05)
+                k += 1
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(1.0)  # live traffic against the healthy agent
+
+        # make sure a periodic CT checkpoint has captured the flow
+        ct_path = os.path.join(str(state), "ct_state.npz")
+        deadline = time.time() + 15
+        while time.time() < deadline and not (
+                os.path.exists(ct_path) and
+                os.path.getmtime(ct_path) > established_at):
+            time.sleep(0.05)
+        assert os.path.exists(ct_path), "no periodic CT checkpoint"
+        assert os.path.getmtime(ct_path) > established_at
+
+        # ---- chaos: SIGKILL mid-traffic ----
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        time.sleep(0.3)  # traffic thread hits the dead window
+
+        # ---- supervisor restart on the same state dir ----
+        proc2, info2 = _spawn(state)
+        assert info2["restored"] == 2
+        ports["verdict"] = info2["verdict_port"]
+        c2 = Client(f"http://127.0.0.1:{info2['api_port']}")
+        slot2 = c2.get("/endpoint/2")["table-slot"]
+        assert slot2 == slot, "table slot moved across restore"
+        vc2 = VerdictClient("127.0.0.1", ports["verdict"], timeout=120)
+
+        # (a) established flow survived the SIGKILL via the periodic
+        #     CT checkpoint — non-SYN continuation, no policy imported
+        v, _ = vc2.classify(_recs(slot, 47001, 5432, ACK))
+        assert int(v[0]) >= 0, "established flow lost by kill -9"
+        # (b) denied stays denied through recovery
+        v, _ = vc2.classify(_recs(slot, 50002, 9999, SYN))
+        assert int(v[0]) < 0, "restore admitted a denied flow"
+        # (c) pinned-map parity: FRESH allowed flow forwards from the
+        #     restored realized state, before any policy re-import
+        v, _ = vc2.classify(_recs(slot, 50003, 5432, SYN))
+        assert int(v[0]) >= 0, "restore dropped an allowed flow"
+        # (d) stale L7 redirects are scrubbed, not served: the
+        #     checkpointed proxy port named the DEAD child's listener,
+        #     so port-80 flows fail closed until policy re-import
+        v, _ = vc2.classify(_recs(slot, 50004, 80, SYN))
+        assert int(v[0]) < 0, "restore served a stale L7 redirect port"
+
+        # ---- orchestrator re-imports policy; system converges ----
+        c2.request("PUT", "/policy", RULES)
+        assert _wait_verdict(vc2, slot, 5432, True, base=52000)
+        assert _wait_verdict(vc2, slot, 9999, False, base=53000)
+
+        # L7 re-sync: the old proxy child (orphaned by the SIGKILL)
+        # must exit when its xDS stream died, and the restarted agent's
+        # supervisor must spawn a successor that re-binds the redirect
+        # port named by the port-80 verdict
+        old_child = info.get("proxy_child_pid")
+        if old_child:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    os.kill(old_child, 0)
+                except ProcessLookupError:
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("orphaned proxy child still alive")
+        deadline = time.time() + 60
+        pport = -1
+        k = 0
+        bound = False
+        while time.time() < deadline and not bound:
+            v, _ = vc2.classify(_recs(slot, 54000 + k, 80, SYN))
+            pport = int(v[0])
+            if pport > 0:
+                try:
+                    s = socket.create_connection(("127.0.0.1", pport),
+                                                 timeout=2)
+                    s.close()
+                    bound = True
+                except OSError:
+                    pass
+            k += 1
+            time.sleep(0.1)
+        assert pport > 0, "L7 redirect not re-established"
+        assert bound, "successor proxy child never re-bound the port"
+
+        stop.set()
+        t.join(timeout=20)
+        assert not t.is_alive(), "traffic thread wedged"
+        assert not wrong_allows, wrong_allows[:5]
+        vc.close()
+        vc2.close()
+    finally:
+        stop.set()
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)

@@ -741,11 +741,13 @@ def test_kvstore_routes_503_without_backend():
 
 
 def test_established_flows_survive_agent_restart(tmp_path):
-    """The pinned-ctmap analog: conntrack state checkpoints at
-    shutdown and restores at start, so flows established under the old
-    policy keep their verdicts across a restart — even before policy
-    is re-imported — while NEW flows hit the (empty) policy and drop.
-    Reference: daemon/state.go + bpf pinned maps."""
+    """The pinned-map analog (daemon/state.go + bpffs): across a
+    restart, BOTH tiers of old state keep enforcing before any policy
+    re-import — conntrack restores so established flows keep their
+    verdicts, and the checkpointed realized policy state restores so
+    NEW flows get the OLD policy's verdicts (allowed sources forward,
+    unknown sources drop), exactly like the reference's pinned maps
+    serving the dataplane while the agent is down."""
     state = str(tmp_path / "state")
     d1 = Daemon(config=DaemonConfig(state_dir=state))
     d1.endpoint_create(11, ipv4="10.0.0.11", labels=["k8s:id=server"])
@@ -769,10 +771,21 @@ def test_established_flows_survive_agent_restart(tmp_path):
     # same 5-tuple: CT hit, still forwarded (no policy re-imported!)
     verdict, *_ = d2.datapath.process(make_full_batch(**flow))
     assert int(np.asarray(verdict)[0]) == 0
-    # fresh flow: CT_NEW against the empty policy -> drop
+    # fresh flow from the client: CT_NEW against the RESTORED realized
+    # policy -> still allowed (old policy's L3 rule), no re-import
     fresh = dict(flow, sport=[45999])
     verdict, *_ = d2.datapath.process(make_full_batch(**fresh))
+    assert int(np.asarray(verdict)[0]) == 0
+    # fresh flow from an unknown source: old policy never allowed it
+    stranger = dict(flow, saddr=["10.9.9.9"], sport=[45998])
+    verdict, *_ = d2.datapath.process(make_full_batch(**stranger))
     assert int(np.asarray(verdict)[0]) < 0
+    # a policy import regenerates and replaces the restored state
+    d2.policy_add(rules_from_json(RULES_JSON))
+    assert d2.wait_for_policy_revision()
+    verdict, *_ = d2.datapath.process(
+        make_full_batch(**dict(flow, sport=[45997])))
+    assert int(np.asarray(verdict)[0]) == 0
     d2.shutdown()
 
 
