@@ -475,6 +475,14 @@ def cmd_agent(args) -> int:
     d = Daemon(config=cfg, kvstore_backend=kv, node_name=args.node_name)
     restored = d.restore_endpoints()
     server = APIServer(d, port=args.api_port).start()
+    k8s_transport = None
+    if getattr(args, "k8s_api_server", ""):
+        # real list/watch informers against an apiserver
+        # (daemon/k8s_watcher.go EnableK8sWatcher analog)
+        from .k8s.client import K8sTransport
+        from .k8s.watcher import K8sWatcher
+        k8s_transport = K8sTransport(K8sWatcher(d),
+                                     args.k8s_api_server).start()
     vsvc = None
     if getattr(args, "verdict_port", 0):
         # the daemon->TPU verdict-service RPC hop: remote ingest
@@ -495,6 +503,8 @@ def cmd_agent(args) -> int:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        if k8s_transport is not None:
+            k8s_transport.stop()
         if vsvc is not None:
             vsvc.shutdown()
         server.shutdown()
@@ -660,6 +670,9 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("--ct-checkpoint-interval", type=float, default=10.0,
                     help="seconds between CT snapshots to state-dir "
                          "(0 = only at clean shutdown)")
+    ag.add_argument("--k8s-api-server", default="",
+                    help="apiserver base URL to list/watch (informer "
+                         "transport; empty = no k8s)")
     return p
 
 

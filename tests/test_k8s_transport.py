@@ -1,0 +1,252 @@
+"""k8s list/watch transport: reflectors over HTTP against the in-repo
+fake apiserver (round-5 VERDICT #5).
+
+The watcher's semantics (ordering, rv dedup, handlers) were already
+tested via direct injection; this suite proves the TRANSPORT — LIST,
+chunked WATCH streams, reconnect-from-last-version on stream loss, and
+the 410-Gone full-relist path — end to end into a real Daemon.
+Reference: daemon/k8s_watcher.go:70-78 client-go informers.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.datapath.engine import make_full_batch
+from cilium_tpu.k8s import K8sWatcher
+from cilium_tpu.k8s.client import (GoneError, K8sClient, K8sTransport,
+                                   Reflector)
+from cilium_tpu.k8s.fake_apiserver import FakeAPIServer
+from cilium_tpu.utils.option import DaemonConfig
+
+CNP_PATH = "/apis/cilium.io/v2/ciliumnetworkpolicies"
+POD_PATH = "/api/v1/pods"
+
+
+def _cnp(name="web-policy", port="80", ns="prod", app="web"):
+    return {
+        "apiVersion": "cilium.io/v2",
+        "kind": "CiliumNetworkPolicy",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "endpointSelector": {"matchLabels": {"app": app}},
+            "ingress": [
+                {"fromEndpoints": [
+                    {"matchLabels": {"app": "client"}}],
+                 "toPorts": [{"ports": [
+                     {"port": port, "protocol": "TCP"}]}]},
+            ],
+        },
+    }
+
+
+def _pod(name, ip, ns="prod", labels=None):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns,
+                     "labels": labels or {"app": "web"}},
+        "status": {"podIP": ip, "hostIP": "192.168.1.10",
+                   "phase": "Running"},
+        "spec": {},
+    }
+
+
+@pytest.fixture()
+def fake():
+    srv = FakeAPIServer().start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture()
+def daemon():
+    d = Daemon(config=DaemonConfig(state_dir=""))
+    yield d
+    d.shutdown()
+
+
+def _wait(fn, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return fn()
+
+
+# ------------------------------------------------------------ raw client
+
+def test_client_list_and_watch_stream(fake):
+    c = K8sClient(fake.base_url)
+    fake.upsert("ciliumnetworkpolicies", _cnp("a"))
+    items, rv = c.list(CNP_PATH)
+    assert len(items) == 1 and items[0]["metadata"]["name"] == "a"
+
+    got = []
+
+    def consume():
+        for etype, obj in c.watch(CNP_PATH, rv):
+            got.append((etype, obj["metadata"]["name"]))
+            if len(got) >= 3:
+                return
+
+    import threading
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    fake.upsert("ciliumnetworkpolicies", _cnp("b"))
+    fake.upsert("ciliumnetworkpolicies", _cnp("b", port="81"))
+    fake.delete("ciliumnetworkpolicies", "prod", "b")
+    t.join(timeout=10)
+    assert got == [("ADDED", "b"), ("MODIFIED", "b"), ("DELETED", "b")]
+
+
+def test_watch_from_compacted_version_is_gone(fake):
+    c = K8sClient(fake.base_url)
+    fake.upsert("ciliumnetworkpolicies", _cnp("a"))
+    fake.upsert("ciliumnetworkpolicies", _cnp("b"))
+    fake.compact()
+    with pytest.raises(GoneError):
+        for _ in c.watch(CNP_PATH, "1"):
+            pass
+
+
+# ----------------------------------------------------------- reflector
+
+def test_reflector_feeds_watcher_and_daemon_enforces(fake, daemon):
+    """The full informer path: object lands in the fake apiserver ->
+    LIST/WATCH -> K8sWatcher -> policy repo -> device verdict."""
+    web = daemon.endpoint_create(1, ipv4="10.0.0.31",
+                                 labels=["k8s:app=client",
+                                         "k8s:io.kubernetes.pod."
+                                         "namespace=prod"])
+    db = daemon.endpoint_create(2, ipv4="10.0.0.32",
+                                labels=["k8s:app=web",
+                                        "k8s:io.kubernetes.pod."
+                                        "namespace=prod"])
+    kw = K8sWatcher(daemon)
+    transport = K8sTransport(kw, fake.base_url)
+    try:
+        transport.start()
+        assert transport.wait_synced(10)
+        fake.upsert("ciliumnetworkpolicies", _cnp())
+        assert _wait(lambda: kw.events_by_kind.get("cnp", 0) >= 1)
+        assert kw.wait_idle(10)
+        assert daemon.wait_for_policy_revision()
+        slot = db.table_slot
+        batch = make_full_batch(
+            endpoint=[slot, slot], saddr=["10.0.0.31", "10.0.0.31"],
+            daddr=["10.0.0.32", "10.0.0.32"], sport=[40100, 40101],
+            dport=[80, 22], direction=[0, 0])
+        v, *_ = daemon.datapath.process(batch)
+        assert int(np.asarray(v)[0]) >= 0   # allowed by the CNP
+        assert int(np.asarray(v)[1]) < 0    # not in the CNP
+        # deletion propagates too
+        fake.delete("ciliumnetworkpolicies", "prod", "web-policy")
+        assert _wait(lambda: kw.events_by_kind.get("cnp", 0) >= 2)
+        assert kw.wait_idle(10)
+        assert _wait(lambda: daemon.repo.revision >= 3)
+    finally:
+        transport.stop()
+        kw.stop()
+
+
+def test_reflector_reconnects_after_stream_drop(fake, daemon):
+    """Network blip: the server drops every watch stream; the
+    reflector re-watches from its last seen version and events created
+    during the gap still arrive, without a relist."""
+    kw = K8sWatcher(daemon)
+    r = Reflector(K8sClient(fake.base_url), POD_PATH, "pod", kw).start()
+    try:
+        assert r.synced.wait(10)
+        fake.upsert("pods", _pod("p1", "10.0.0.41"))
+        assert _wait(lambda: kw.events_by_kind.get("pod", 0) >= 1)
+        relists_before = r.relists
+
+        fake.disconnect_watchers()
+        # during the "outage" (between streams) an event happens
+        fake.upsert("pods", _pod("p2", "10.0.0.42"))
+        assert _wait(lambda: kw.events_by_kind.get("pod", 0) >= 2)
+        assert _wait(lambda: r.rewatches >= 2)
+        assert r.relists == relists_before, \
+            "stream drop must resume from last rv, not relist"
+        assert daemon.ipcache.lookup_by_ip("10.0.0.42") is not None
+    finally:
+        r.stop()
+        kw.stop()
+
+
+def test_reflector_410_gone_triggers_full_relist(fake, daemon):
+    """Compaction: watch from a stale version answers 410; the
+    reflector relists and converges, including deletions that happened
+    while it was disconnected (DeletedFinalStateUnknown analog)."""
+    kw = K8sWatcher(daemon)
+    fake.upsert("pods", _pod("stay", "10.0.0.51"))
+    fake.upsert("pods", _pod("doomed", "10.0.0.52"))
+    r = Reflector(K8sClient(fake.base_url), POD_PATH, "pod", kw).start()
+    try:
+        assert r.synced.wait(10)
+        assert _wait(
+            lambda: daemon.ipcache.lookup_by_ip("10.0.0.52") is not None)
+        relists_before = r.relists
+
+        # simulate a long partition: stream dies, history is compacted,
+        # and the cluster changes shape meanwhile
+        fake.delete("pods", "prod", "doomed")
+        fake.upsert("pods", _pod("newcomer", "10.0.0.53"))
+        fake.compact()
+        fake.disconnect_watchers()
+
+        assert _wait(lambda: r.relists > relists_before), \
+            "410 must force a relist"
+        assert _wait(
+            lambda: daemon.ipcache.lookup_by_ip("10.0.0.53") is not None)
+        # the deletion during the partition was reconstructed by the
+        # relist diff
+        assert _wait(
+            lambda: daemon.ipcache.lookup_by_ip("10.0.0.52") is None)
+        assert daemon.ipcache.lookup_by_ip("10.0.0.51") is not None
+    finally:
+        r.stop()
+        kw.stop()
+
+
+def test_relist_resync_is_deduped_by_resource_version(fake, daemon):
+    """A relist re-delivers every object; the watcher's rv dedup must
+    drop the unchanged ones instead of re-applying handlers."""
+    kw = K8sWatcher(daemon)
+    fake.upsert("pods", _pod("p1", "10.0.0.61"))
+    r = Reflector(K8sClient(fake.base_url), POD_PATH, "pod", kw).start()
+    try:
+        assert r.synced.wait(10)
+        assert _wait(lambda: kw.events_by_kind.get("pod", 0) == 1)
+        applied_before = kw.events_by_kind.get("pod", 0)
+        # force a pod relist without POD churn: advance the global
+        # resourceVersion via another resource, then compact — the pod
+        # watcher's version now predates the compaction (410), but the
+        # relist re-delivers only the unchanged p1
+        fake.upsert("services", {
+            "metadata": {"name": "svc", "namespace": "prod"},
+            "spec": {"clusterIP": "10.96.0.99",
+                     "ports": [{"port": 80, "protocol": "TCP"}]}})
+        fake.compact()
+        fake.disconnect_watchers()
+        assert _wait(lambda: r.relists >= 2)
+        time.sleep(0.3)
+        assert kw.events_by_kind.get("pod", 0) == applied_before, \
+            "unchanged object re-applied on resync"
+    finally:
+        r.stop()
+        kw.stop()
+
+
+def test_transport_stop_terminates_reflector_threads(fake, daemon):
+    kw = K8sWatcher(daemon)
+    transport = K8sTransport(kw, fake.base_url).start()
+    assert transport.wait_synced(10)
+    transport.stop()
+    for r in transport.reflectors:
+        assert not r._thread.is_alive(), r.kind
+    kw.stop()
