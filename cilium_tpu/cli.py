@@ -471,7 +471,30 @@ def cmd_agent(args) -> int:
                            args, "ct_checkpoint_interval", 10.0))
     kv = None
     if args.kvstore and args.kvstore != "none":
-        kv = setup_client(args.kvstore)
+        # --kvstore-opt port=2379 lease_ttl=15 ... (daemon/main.go
+        # --kvstore-opt analog); numeric values coerce so backend
+        # constructors get real ints/floats
+        opts = {}
+        for item in getattr(args, "kvstore_opt", None) or []:
+            k, sep, v = item.partition("=")
+            if not sep or not k or not v:
+                raise SystemExit(
+                    f"--kvstore-opt {item!r}: expected key=value")
+            try:
+                opts[k] = int(v)
+            except ValueError:
+                try:
+                    opts[k] = float(v)
+                except ValueError:
+                    opts[k] = v
+        try:
+            kv = setup_client(args.kvstore, **opts)
+        except KeyError:
+            raise SystemExit(f"unknown kvstore backend "
+                             f"{args.kvstore!r}")
+        except TypeError as e:
+            raise SystemExit(f"bad --kvstore-opt for "
+                             f"{args.kvstore!r}: {e}")
     d = Daemon(config=cfg, kvstore_backend=kv, node_name=args.node_name)
     restored = d.restore_endpoints()
     server = APIServer(d, port=args.api_port).start()
@@ -662,7 +685,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="serve the batch verdict service on this "
                          "port (0 = disabled)")
     ag.add_argument("--kvstore", default="none",
-                    help="none | in-memory | backend name")
+                    help="none | in-memory | remote | etcd")
+    ag.add_argument("--kvstore-opt", action="append", default=[],
+                    help="backend option key=value (repeatable), "
+                         "e.g. --kvstore-opt port=2379")
     ag.add_argument("--cluster-name", default="default")
     ag.add_argument("--cluster-id", type=int, default=0)
     ag.add_argument("--node-name", default="node-local")

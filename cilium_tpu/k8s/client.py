@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import http.client
 import json
-import socket as _socket
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -41,23 +40,7 @@ class GoneError(Exception):
     """410: the requested resourceVersion was compacted away."""
 
 
-def _teardown_conn(conn) -> None:
-    """Kill a (possibly streaming) HTTPConnection without blocking.
-
-    HTTPConnection.close() drains the open chunked response first,
-    which blocks forever on a live watch stream — shutdown() the raw
-    socket first so the drain reads EOF instantly.  Safe on a
-    never-connected conn (sock is None)."""
-    sock = getattr(conn, "sock", None)
-    if sock is not None:
-        try:
-            sock.shutdown(_socket.SHUT_RDWR)
-        except OSError:
-            pass
-    try:
-        conn.close()
-    except OSError:
-        pass
+from ..utils.netio import teardown_http_conn as _teardown_conn  # noqa: E402
 
 
 class K8sClient:
@@ -242,7 +225,16 @@ class Reflector:
             except GoneError:
                 # compacted: full relist is the ONLY correct recovery
                 rv = None
-            except OSError:
+            except AttributeError:
+                # http.client nulls resp.fp when stop() closes the
+                # connection under a blocked reader; ONLY during stop
+                # is that a dead stream — otherwise it's a real bug
+                if not self._stop.is_set():
+                    raise
+            except (OSError, http.client.HTTPException):
+                # HTTPException covers NotConnected from a conn the
+                # stop path tore down (auto_open cleared) and
+                # IncompleteRead from a stream cut mid-chunk
                 failures += 1
                 self._stop.wait(min(self.backoff_base * (2 ** failures),
                                     self.backoff_max))

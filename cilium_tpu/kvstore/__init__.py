@@ -20,13 +20,15 @@ from .backend import (EVENT_CREATE, EVENT_DELETE, EVENT_LIST_DONE,
                       EVENT_MODIFY, BackendOperations, Event, KVLockError,
                       close_client, get_client, register_backend,
                       setup_client, setup_dummy)
+from .etcd import EtcdBackend
 from .memory import InMemoryBackend
+from .mini_etcd import MiniEtcd
 from .remote import RemoteBackend
 from .server import KVStoreServer
 
 __all__ = [
-    "BackendOperations", "Event", "InMemoryBackend", "KVLockError",
-    "KVStoreServer", "RemoteBackend",
+    "BackendOperations", "EtcdBackend", "Event", "InMemoryBackend",
+    "KVLockError", "KVStoreServer", "MiniEtcd", "RemoteBackend",
     "EVENT_CREATE", "EVENT_MODIFY", "EVENT_DELETE", "EVENT_LIST_DONE",
     "setup_client", "setup_dummy", "get_client", "close_client",
     "register_backend",

@@ -11,6 +11,28 @@ import socket
 from typing import Optional
 
 
+def teardown_http_conn(conn) -> None:
+    """Kill a (possibly streaming) http.client.HTTPConnection without
+    blocking, PERMANENTLY: close() drains any open chunked response
+    first, which blocks forever on a live stream — shutdown() the raw
+    socket so the drain reads EOF instantly.  auto_open is cleared
+    because http.client otherwise silently RECONNECTS on the next
+    request over a closed conn, resurrecting a socket its killer can
+    no longer reach (the racing user gets NotConnected instead).
+    Safe on a never-connected conn."""
+    conn.auto_open = 0
+    sock = getattr(conn, "sock", None)
+    if sock is not None:
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+    try:
+        conn.close()
+    except OSError:
+        pass
+
+
 def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     """Read exactly n bytes; None on EOF or socket error."""
     buf = bytearray(n)

@@ -6,9 +6,11 @@ identities over the wire), reports state as one JSON line on stdout,
 then either exits or sleeps until killed (kill -9 models node death:
 the lease stops renewing and the server reaps the session).
 
-Usage: python tests/agent_proc.py <port> <node_name> <mode> <ttl>
+Usage: python tests/agent_proc.py <port> <node_name> <mode> <ttl> [backend]
   mode "report": allocate, print, clean shutdown
   mode "sleep":  allocate, print, then sleep forever (parent kills -9)
+  backend: "remote" (default, TCP kvstore) or "etcd" (etcd v3 JSON
+  protocol against a mini-etcd/real gateway on <port>)
 """
 
 import json
@@ -34,8 +36,13 @@ def main() -> None:
     node = sys.argv[2]
     mode = sys.argv[3]
     ttl = float(sys.argv[4]) if len(sys.argv) > 4 else 2.0
+    backend = sys.argv[5] if len(sys.argv) > 5 else "remote"
 
-    kv = RemoteBackend(port=port, lease_ttl=ttl)
+    if backend == "etcd":
+        from cilium_tpu.kvstore.etcd import EtcdBackend
+        kv = EtcdBackend(port=port, lease_ttl=ttl)
+    else:
+        kv = RemoteBackend(port=port, lease_ttl=ttl)
     d = Daemon(config=DaemonConfig(), kvstore_backend=kv, node_name=node)
     try:
         # two endpoints: one with cluster-shared labels, one node-unique

@@ -247,6 +247,15 @@ def test_transport_stop_terminates_reflector_threads(fake, daemon):
     transport = K8sTransport(kw, fake.base_url).start()
     assert transport.wait_synced(10)
     transport.stop()
-    for r in transport.reflectors:
-        assert not r._thread.is_alive(), r.kind
+    stuck = [r for r in transport.reflectors if r._thread.is_alive()]
+    if stuck:
+        import sys
+        import traceback
+        frames = sys._current_frames()
+        detail = "\n".join(
+            f"--- {r.kind}\n" + "".join(
+                traceback.format_stack(frames[r._thread.ident]))
+            for r in stuck if r._thread.ident in frames)
+        raise AssertionError(
+            f"stuck reflectors {[r.kind for r in stuck]}:\n{detail}")
     kw.stop()
