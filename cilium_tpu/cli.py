@@ -498,6 +498,14 @@ def cmd_agent(args) -> int:
     d = Daemon(config=cfg, kvstore_backend=kv, node_name=args.node_name)
     restored = d.restore_endpoints()
     server = APIServer(d, port=args.api_port).start()
+    docker_watcher = None
+    if getattr(args, "docker_socket", ""):
+        # real dockerd events client (pkg/workloads/docker.go analog)
+        from .workloads import (DockerClient, DockerEventWatcher,
+                                WorkloadWatcher)
+        docker_watcher = DockerEventWatcher(
+            DockerClient(args.docker_socket),
+            WorkloadWatcher(d, ipam=d.ipam)).start()
     k8s_transport = None
     if getattr(args, "k8s_api_server", ""):
         # real list/watch informers against an apiserver
@@ -526,6 +534,8 @@ def cmd_agent(args) -> int:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        if docker_watcher is not None:
+            docker_watcher.stop()
         if k8s_transport is not None:
             k8s_transport.stop()
         if vsvc is not None:
@@ -699,6 +709,9 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("--k8s-api-server", default="",
                     help="apiserver base URL to list/watch (informer "
                          "transport; empty = no k8s)")
+    ag.add_argument("--docker-socket", default="",
+                    help="dockerd unix socket to watch container "
+                         "events on (empty = no docker runtime)")
     return p
 
 

@@ -1,0 +1,285 @@
+"""Docker-events workload watcher over a real unix socket
+(round-5 VERDICT #7).
+
+The pluggable WorkloadWatcher proved the endpoint-lifecycle logic; this
+proves the TRANSPORT: a Docker Engine API client speaking HTTP over
+the dockerd unix socket against an in-repo fake dockerd — initial
+container sync, streaming /events subscription, inspect-on-start,
+die-cleanup, and reconnect-with-resync.  Reference:
+pkg/workloads/docker.go EnableEventListener + processCreateWorkload.
+"""
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.utils.option import DaemonConfig
+from cilium_tpu.workloads import (DockerClient, DockerEventWatcher,
+                                  WorkloadWatcher)
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    # AF_UNIX client addresses are empty strings; the base class logs
+    # would explode on them
+    def log_message(self, *args):
+        pass
+
+    def address_string(self):
+        return "unix"
+
+    def do_GET(self):  # noqa: N802 — http.server contract
+        dockerd = self.server.dockerd
+        if self.path.startswith("/events"):
+            self._stream_events(dockerd)
+            return
+        if self.path.startswith("/containers/json"):
+            with dockerd._cond:
+                out = [
+                    {"Id": cid, "Names": [f"/{c['name']}"],
+                     "Labels": dict(c["labels"]), "State": "running"}
+                    for cid, c in dockerd.containers.items()]
+            self._json(200, out)
+            return
+        if self.path.startswith("/containers/"):
+            cid = self.path.split("/")[2]
+            with dockerd._cond:
+                c = dockerd.containers.get(cid)
+            if c is None:
+                self._json(404, {"message": "no such container"})
+                return
+            self._json(200, {"Id": cid, "Name": f"/{c['name']}",
+                             "Config": {"Labels": dict(c["labels"])},
+                             "State": {"Running": True}})
+            return
+        self._json(404, {"message": f"unknown path {self.path}"})
+
+    def _stream_events(self, dockerd) -> None:
+        with dockerd._cond:
+            cursor = len(dockerd.events)
+            epoch = dockerd.epoch
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while True:
+                with dockerd._cond:
+                    while cursor >= len(dockerd.events) and \
+                            dockerd.epoch == epoch:
+                        dockerd._cond.wait(timeout=0.5)
+                    if dockerd.epoch != epoch:
+                        break
+                    batch = dockerd.events[cursor:]
+                    cursor = len(dockerd.events)
+                for ev in batch:
+                    data = (json.dumps(ev) + "\n").encode()
+                    self.wfile.write(b"%x\r\n" % len(data) + data +
+                                     b"\r\n")
+                    self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        self.close_connection = True
+
+    def _json(self, code: int, obj) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class FakeDockerd:
+    """In-repo dockerd: container store + /events stream over a unix
+    socket; start_container/stop_container are the test's hands."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._cond = threading.Condition()
+        self.containers = {}
+        self.events = []
+        self.epoch = 0  # bump = drop live event streams
+        srv = _UnixHTTPServer(socket_path, _Handler)
+        srv.dockerd = self
+        self._srv = srv
+        self._thread = threading.Thread(target=srv.serve_forever,
+                                        daemon=True, name="fake-dockerd")
+
+    def start(self) -> "FakeDockerd":
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self.epoch += 1
+            self._cond.notify_all()
+        self._srv.shutdown()
+        self._srv.server_close()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def start_container(self, cid: str, name: str, labels=None) -> None:
+        with self._cond:
+            self.containers[cid] = {"name": name,
+                                    "labels": labels or {}}
+            self.events.append({
+                "Type": "container", "Action": "start",
+                "Actor": {"ID": cid,
+                          "Attributes": dict(labels or {})}})
+            self._cond.notify_all()
+
+    def stop_container(self, cid: str) -> None:
+        with self._cond:
+            self.containers.pop(cid, None)
+            self.events.append({
+                "Type": "container", "Action": "die",
+                "Actor": {"ID": cid, "Attributes": {}}})
+            self._cond.notify_all()
+
+    def drop_streams(self) -> None:
+        with self._cond:
+            self.epoch += 1
+            self._cond.notify_all()
+
+
+@pytest.fixture()
+def dockerd(tmp_path):
+    d = FakeDockerd(str(tmp_path / "docker.sock")).start()
+    yield d
+    d.shutdown()
+
+
+@pytest.fixture()
+def daemon():
+    d = Daemon(config=DaemonConfig(state_dir=""))
+    yield d
+    d.shutdown()
+
+
+def _wait(fn, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return fn()
+
+
+def test_client_speaks_engine_api_over_unix_socket(dockerd):
+    c = DockerClient(dockerd.socket_path)
+    assert c.ping()
+    dockerd.start_container("c1" * 32, "web", {"app": "web"})
+    lst = c.list_containers()
+    assert len(lst) == 1 and lst[0]["Labels"] == {"app": "web"}
+    ins = c.inspect("c1" * 32)
+    assert ins["Name"] == "/web"
+    assert ins["Config"]["Labels"] == {"app": "web"}
+
+
+def test_events_stream_start_die(dockerd):
+    c = DockerClient(dockerd.socket_path)
+    got = []
+
+    def consume():
+        for ev in c.events():
+            got.append((ev["Action"], ev["Actor"]["ID"]))
+            if len(got) >= 2:
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    dockerd.start_container("aa" * 32, "a")
+    dockerd.stop_container("aa" * 32)
+    t.join(timeout=10)
+    assert got == [("start", "aa" * 32), ("die", "aa" * 32)]
+
+
+def test_container_lifecycle_drives_endpoints(dockerd, daemon):
+    """The full round trip: docker start -> inspect -> endpoint with
+    container labels + IPAM address; docker die -> endpoint gone and
+    the address released."""
+    sink = WorkloadWatcher(daemon, ipam=daemon.ipam)
+    w = DockerEventWatcher(DockerClient(dockerd.socket_path),
+                           sink).start()
+    try:
+        assert w.synced.wait(10)
+        cid = "bb" * 32
+        dockerd.start_container(cid, "web-1", {"app": "web"})
+        assert _wait(lambda: sink.endpoint_of(cid) is not None)
+        ep = daemon.endpoints.lookup(sink.endpoint_of(cid))
+        assert ep is not None
+        assert ep.ipv4, "endpoint should get an IPAM address"
+        assert any("app=web" in str(l) for l in ep.labels.to_array())
+        ip = ep.ipv4
+        dockerd.stop_container(cid)
+        assert _wait(lambda: sink.endpoint_of(cid) is None)
+        assert _wait(lambda: daemon.endpoints.lookup(ep.id) is None)
+        # the address is free again (release happens just after the
+        # endpoint disappears — poll, don't race it)
+        assert _wait(lambda: daemon.ipam.owner_of(ip) is None)
+    finally:
+        w.stop()
+
+
+def test_initial_sync_adopts_preexisting_containers(dockerd, daemon):
+    """Containers started while the agent was down are adopted by the
+    list-then-watch startup (docker.go runtime sync)."""
+    dockerd.start_container("cc" * 32, "old-1", {"app": "old"})
+    sink = WorkloadWatcher(daemon, ipam=daemon.ipam)
+    w = DockerEventWatcher(DockerClient(dockerd.socket_path),
+                           sink).start()
+    try:
+        assert w.synced.wait(10)
+        assert _wait(lambda: sink.endpoint_of("cc" * 32) is not None)
+    finally:
+        w.stop()
+
+
+def test_stream_drop_resyncs_and_reaps_gap_deaths(dockerd, daemon):
+    """A container dying while the event stream is down must still be
+    cleaned up: reconnect re-lists and diffs (the reference re-syncs
+    on EnableEventListener reconnect)."""
+    sink = WorkloadWatcher(daemon, ipam=daemon.ipam)
+    w = DockerEventWatcher(DockerClient(dockerd.socket_path),
+                           sink).start()
+    try:
+        assert w.synced.wait(10)
+        cid = "dd" * 32
+        dockerd.start_container(cid, "doomed")
+        assert _wait(lambda: sink.endpoint_of(cid) is not None)
+        resyncs = w.resyncs
+        # partition: stream drops AND the container dies silently
+        with dockerd._cond:
+            dockerd.containers.pop(cid, None)  # no event recorded
+        dockerd.drop_streams()
+        assert _wait(lambda: w.resyncs > resyncs)
+        assert _wait(lambda: sink.endpoint_of(cid) is None), \
+            "gap death must be reaped by the reconnect resync"
+    finally:
+        w.stop()
+
+
+def test_watcher_stop_terminates_thread(dockerd, daemon):
+    sink = WorkloadWatcher(daemon, ipam=daemon.ipam)
+    w = DockerEventWatcher(DockerClient(dockerd.socket_path),
+                           sink).start()
+    assert w.synced.wait(10)
+    w.stop()
+    assert not w._thread.is_alive()
