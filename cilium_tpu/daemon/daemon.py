@@ -101,6 +101,10 @@ class Daemon:
         # — identity checks only
         self.host_ipv6 = self.ipam6.router_ip() \
             if self.ipam6 is not None else ""
+        if self.host_ipv6:
+            # the ICMPv6/NDP responder answers NS/echo for this
+            # address (icmp6.h ROUTER_IP; written by datapath init)
+            self.datapath.set_router_ip6(self.host_ipv6)
 
         # L7 access-log records join the monitor stream
         # (LogRecordNotify analog: pkg/proxy/logger -> monitor)

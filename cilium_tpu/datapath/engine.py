@@ -80,10 +80,28 @@ class Datapath:
         self._tables: Optional[FullTables] = None
         self._step6 = None
         self._tables6: Optional[FullTables6] = None
+        # the node's v6 router IP words (icmp6.h ROUTER_IP): the
+        # address whose NS/echo the datapath answers itself
+        self._router_ip6 = None
         # incremental mode: policy tensors owned by a DeviceTableManager
         # (endpoint/tables.py); row syncs swap tensors without re-jit
         self._table_mgr = None
         self._mgr_geometry = None  # (capacity, slots, max_probe, gen)
+
+    def set_router_ip6(self, ip: str) -> None:
+        """Program the v6 router address the ICMPv6/NDP responder
+        stage answers for (datapath init writes ROUTER_IP into the
+        generated header; bpf/lib/icmp6.h reads it back)."""
+        from ..compiler.lpm import ipv6_to_words
+        with self._lock:
+            # words are unsigned u32; the device tables carry them as
+            # bit-identical int32 (same convention as addr6 batches)
+            self._router_ip6 = jnp.asarray(
+                np.asarray(ipv6_to_words(ip), np.uint32)
+                .view(np.int32))
+            if self._tables6 is not None:
+                self._tables6 = self._tables6._replace(
+                    router_ip6=self._router_ip6)
 
     # -- table loading -------------------------------------------------------
 
@@ -299,7 +317,8 @@ class Datapath:
         self._tables6 = FullTables6(
             key_id=dp.key_id, key_meta=dp.key_meta, value=dp.value,
             ipcache6=lpm6_tables(ipc6), pf6=lpm6_tables(pf6),
-            lb6=lb6.tables if lb6 is not None else None)
+            lb6=lb6.tables if lb6 is not None else None,
+            router_ip6=self._router_ip6)
         self._step6 = jax.jit(functools.partial(
             full_datapath_step6,
             policy_probe=policy_probe,
@@ -508,10 +527,12 @@ def make_full_batch(endpoint, saddr, daddr, sport, dport, proto=None,
 def make_full_batch6(endpoint, saddr, daddr, sport, dport, proto=None,
                      direction=None, tcp_flags=None, length=None,
                      is_fragment=None, from_overlay=None,
-                     tunnel_id=None, mark_identity=None
+                     tunnel_id=None, mark_identity=None,
+                     icmp_type=None, nd_target=None
                      ) -> FullPacketBatch6:
     """v6 batch builder: saddr/daddr accept v6 strings or [B, 4] int32
-    word arrays."""
+    word arrays; icmp_type/nd_target feed the ICMPv6/NDP responder
+    stage (nd_target accepts strings or [B, 4] words too)."""
     n = len(np.asarray(endpoint))
     arr = lambda x, d: jnp.asarray(np.asarray(
         x if x is not None else np.full(n, d), np.int32))
@@ -534,6 +555,10 @@ def make_full_batch6(endpoint, saddr, daddr, sport, dport, proto=None,
                               tunnel_id=arr(tunnel_id, 0))
     if mark_identity is not None:
         overlay_fields["mark_identity"] = arr(mark_identity, 0)
+    if icmp_type is not None or nd_target is not None:
+        overlay_fields["icmp_type"] = arr(icmp_type, 0)
+        overlay_fields["nd_target"] = addr6(nd_target) \
+            if nd_target is not None else jnp.zeros((n, 4), jnp.int32)
     return FullPacketBatch6(
         endpoint=arr(endpoint, 0), saddr=addr6(saddr),
         daddr=addr6(daddr), sport=arr(sport, 0), dport=arr(dport, 0),

@@ -14,6 +14,10 @@ TRACE_TO_PROXY = 1      # redirected to proxy
 TRACE_TO_HOST = 2
 TRACE_TO_STACK = 3
 TRACE_TO_OVERLAY = 4    # encapped to remote node
+# ICMPv6 answered in-datapath (bpf/lib/icmp6.h terminal actions): the
+# packet is not forwarded; the responder synthesizes the reply
+ICMP6_NS_REPLY = 5      # NS for the router -> neighbour advertisement
+ICMP6_ECHO_REPLY = 6    # echo request to the router -> echo reply
 
 # Drop reasons (negative codes, mirroring DROP_* semantics).
 DROP_POLICY = -130          # common.h DROP_POLICY analog
@@ -22,6 +26,7 @@ DROP_CT_INVALID_HDR = -132
 DROP_PREFILTER = -133       # XDP prefilter (bpf_xdp.c check_filters)
 DROP_POLICY_L7 = -134
 DROP_INVALID = -135
+DROP_UNKNOWN_TARGET = -136  # icmp6.h ACTION_UNKNOWN_ICMP6_NS analog
 
 DROP_NAMES = {
     DROP_POLICY: "Policy denied (L3/L4)",
@@ -30,6 +35,7 @@ DROP_NAMES = {
     DROP_PREFILTER: "Prefilter denied",
     DROP_POLICY_L7: "Policy denied (L7)",
     DROP_INVALID: "Invalid packet",
+    DROP_UNKNOWN_TARGET: "Unknown ICMPv6 ND target",
 }
 
 TRACE_NAMES = {
@@ -38,4 +44,6 @@ TRACE_NAMES = {
     TRACE_TO_HOST: "to-host",
     TRACE_TO_STACK: "to-stack",
     TRACE_TO_OVERLAY: "to-overlay",
+    ICMP6_NS_REPLY: "icmp6-ns-reply",
+    ICMP6_ECHO_REPLY: "icmp6-echo-reply",
 }

@@ -345,8 +345,19 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
 # reference's documented CT races — not a policy bypass, because policy
 # runs on the ipcache identity, which uses full 128-bit compares.
 
+IPPROTO_ICMPV6 = 58
+ICMP6_NS = 135            # neighbour solicitation
+ICMP6_NA = 136            # neighbour advertisement
+ICMP6_ECHO_REQUEST = 128
+
+
 class FullPacketBatch6(NamedTuple):
-    """v6 wire metadata; addresses [B, 4], everything else [B] int32."""
+    """v6 wire metadata; addresses [B, 4], everything else [B] int32.
+
+    ``icmp_type`` carries the ICMPv6 type for proto-58 rows (0
+    elsewhere); ``nd_target`` the ND target address of NS packets
+    ([B, 4], zeros elsewhere) — bpf/lib/icmp6.h reads both from the
+    wire at ICMP6_TYPE_OFFSET / ICMP6_ND_TARGET_OFFSET."""
 
     endpoint: jnp.ndarray
     saddr: jnp.ndarray       # [B, 4]
@@ -361,6 +372,8 @@ class FullPacketBatch6(NamedTuple):
     from_overlay: jnp.ndarray = None
     tunnel_id: jnp.ndarray = None
     mark_identity: jnp.ndarray = None
+    icmp_type: jnp.ndarray = None
+    nd_target: jnp.ndarray = None
 
 
 class LPM6Tables(NamedTuple):
@@ -392,6 +405,10 @@ class FullTables6(NamedTuple):
     ipcache6: LPM6Tables
     pf6: LPM6Tables
     lb6: object = None       # LB6Tables (None = no v6 services)
+    # the node's router IP words [4] (icmp6.h BPF_V6(router, ROUTER_IP))
+    # — the address whose NS/echo the datapath answers itself; None
+    # disables the ICMPv6 responder stage
+    router_ip6: jnp.ndarray = None
 
 
 def lpm6_tables(c) -> LPM6Tables:
@@ -426,7 +443,8 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
     from ..ops.lpm_ops import lpm6_lookup
     from .conntrack import CT_NEW, CTBatch, ct_step
     from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_PREFILTER,
-                         TRACE_TO_LXC, TRACE_TO_PROXY)
+                         DROP_UNKNOWN_TARGET, ICMP6_ECHO_REPLY,
+                         ICMP6_NS_REPLY, TRACE_TO_LXC, TRACE_TO_PROXY)
     from .lb import lb6_rev_nat, lb6_step
     from .verdict import VERDICT_DROP, VERDICT_DROP_FRAG, verdict_step
 
@@ -441,6 +459,32 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                                 pkt.saddr, pf6_probe)
     else:
         pf_hit = jnp.zeros(b, bool)
+
+    # 1.5 ICMPv6/NDP responder (bpf/lib/icmp6.h icmp6_handle, called
+    # before LB/CT/policy on the from-container path bpf_lxc.c:403-408):
+    # an NS whose ND target is the router answers with an NA
+    # (send_icmp6_ndisc_adv terminal action); an NS for anything else
+    # drops (ACTION_UNKNOWN_ICMP6_NS); an echo request addressed to
+    # the router answers with an echo reply.  Every other ICMPv6 type
+    # (NA, RS/RA, errors, echo to peers) flows on through CT + policy
+    # like the reference's fall-through `return 0`.
+    is_icmp6 = pkt.proto == IPPROTO_ICMPV6
+    if tables.router_ip6 is not None and pkt.icmp_type is not None:
+        icmp_type = pkt.icmp_type
+        is_ns = is_icmp6 & (icmp_type == ICMP6_NS)
+        nd_target = pkt.nd_target if pkt.nd_target is not None \
+            else jnp.zeros_like(pkt.saddr)
+        target_is_router = jnp.all(
+            nd_target == tables.router_ip6[None, :], axis=1)
+        ns_answer = is_ns & target_is_router
+        ns_unknown = is_ns & ~target_is_router
+        echo_answer = is_icmp6 & (icmp_type == ICMP6_ECHO_REQUEST) & \
+            jnp.all(pkt.daddr == tables.router_ip6[None, :], axis=1)
+        icmp6_handled = ns_answer | ns_unknown | echo_answer
+    else:
+        icmp_type = jnp.zeros(b, jnp.int32)
+        ns_answer = ns_unknown = echo_answer = jnp.zeros(b, bool)
+        icmp6_handled = jnp.zeros(b, bool)
 
     # 2. Service LB DNAT (lb.h lb6_local).
     if lb6_probe > 0 and tables.lb6 is not None:
@@ -483,23 +527,28 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                      dport=dport, proto=pkt.proto,
                      direction=pkt.direction, length=pkt.length,
                      is_fragment=pkt.is_fragment)
-    pol_verdict, counters = verdict_step(tables.key_id, tables.key_meta,
-                                         tables.value, counters, vb,
-                                         policy_probe)
+    pol_verdict, counters = verdict_step(
+        tables.key_id, tables.key_meta, tables.value, counters, vb,
+        policy_probe, count_mask=~icmp6_handled)
 
     # 6. CT step, creation gated on the verdict; new entries record the
-    # flow's rev-NAT index so replies can restore the VIP.
-    create_ok = (pol_verdict >= 0) & ~pf_hit
+    # flow's rev-NAT index so replies can restore the VIP.  Locally
+    # answered ICMPv6 never creates CT state (the reply is synthesized,
+    # not forwarded).
+    create_ok = (pol_verdict >= 0) & ~pf_hit & ~icmp6_handled
     proxy_in = jnp.maximum(pol_verdict, 0)
     ct_verdict, ct_rev_nat, ct_proxy, ct = ct_step(
-        ct, ctb, now, create_ok, update_mask=~pf_hit,
+        ct, ctb, now, create_ok, update_mask=~pf_hit & ~icmp6_handled,
         rev_nat_in=rev_nat, proxy_port_in=proxy_in,
         slots=ct_slots, max_probe=ct_probe)
 
     established = ct_verdict != CT_NEW
     verdict = jnp.where(
         pf_hit, jnp.int32(VERDICT_DROP),
-        jnp.where(established, ct_proxy, pol_verdict))
+        jnp.where(ns_unknown, jnp.int32(VERDICT_DROP),
+                  jnp.where(ns_answer | echo_answer, jnp.int32(0),
+                            jnp.where(established, ct_proxy,
+                                      pol_verdict))))
 
     # 7. Reply-path reverse NAT (lb6_rev_nat).
     from .conntrack import CT_RELATED, CT_REPLY
@@ -513,12 +562,15 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
 
     event = jnp.where(
         pf_hit, jnp.int32(DROP_PREFILTER),
+        jnp.where(ns_answer, jnp.int32(ICMP6_NS_REPLY),
+        jnp.where(echo_answer, jnp.int32(ICMP6_ECHO_REPLY),
+        jnp.where(ns_unknown, jnp.int32(DROP_UNKNOWN_TARGET),
         jnp.where(verdict == VERDICT_DROP_FRAG,
                   jnp.int32(DROP_FRAG_NOSUPPORT),
                   jnp.where(verdict < 0, jnp.int32(DROP_POLICY),
                             jnp.where(verdict > 0,
                                       jnp.int32(TRACE_TO_PROXY),
-                                      jnp.int32(TRACE_TO_LXC)))))
+                                      jnp.int32(TRACE_TO_LXC))))))))
     nat = NAT6Result(daddr=daddr, dport=dport, saddr=nat_saddr,
                      sport=nat_sport, rev_nat=ct_rev_nat)
     return verdict, event, identity, nat, ct, counters

@@ -56,9 +56,16 @@ def _pack_meta_vec(dport, proto, direction):
 
 def verdict_step(key_id: jnp.ndarray, key_meta: jnp.ndarray,
                  value: jnp.ndarray, counters: Counters,
-                 pkt: PacketBatch, max_probe: int
+                 pkt: PacketBatch, max_probe: int,
+                 count_mask: "jnp.ndarray | None" = None
                  ) -> Tuple[jnp.ndarray, Counters]:
-    """Pure batched verdict function (jit/shard_map friendly)."""
+    """Pure batched verdict function (jit/shard_map friendly).
+
+    ``count_mask`` (bool [B]) excludes rows from the per-entry
+    packet/byte counters without changing their verdicts — used for
+    packets another stage already answered terminally (ICMPv6
+    NS/echo), which in the reference never reach the policy program
+    at all (bpf_lxc.c calls icmp6_handle before policy)."""
     frag = pkt.is_fragment.astype(bool)
     meta_exact = _pack_meta_vec(pkt.dport, pkt.proto, pkt.direction)
     meta_l3 = _pack_meta_vec(jnp.zeros_like(pkt.dport),
@@ -88,8 +95,10 @@ def verdict_step(key_id: jnp.ndarray, key_meta: jnp.ndarray,
     hit_slot = jnp.where(f1, s1, jnp.where(f2, s2, s3))
     # Per-entry counters (policy.h:67-101 packets/bytes adds). Misses
     # scatter into slot 0 with weight 0 (no-op).
-    inc_p = hit.astype(jnp.uint32)
-    inc_b = jnp.where(hit, pkt.length.astype(jnp.uint32), jnp.uint32(0))
+    counted = hit if count_mask is None else (hit & count_mask)
+    inc_p = counted.astype(jnp.uint32)
+    inc_b = jnp.where(counted, pkt.length.astype(jnp.uint32),
+                      jnp.uint32(0))
     packets = counters.packets.at[hit_slot].add(inc_p)
     bytes_ = counters.bytes.at[hit_slot].add(inc_b)
     return verdict, Counters(packets=packets, bytes=bytes_)

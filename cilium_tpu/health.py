@@ -39,6 +39,57 @@ class PathStatus:
         return bool(self.icmp_ok) and self.http_ok is not False
 
 
+def make_icmp6_probe(resolve_datapath, src_ip6: str):
+    """ICMPv6 probe riding the NDP/echo responder stage (pipeline
+    stage 1.5; bpf/lib/icmp6.h): the echo request classifies through
+    the datapath of the node that OWNS the probed address — a
+    responder only answers for its own router_ip6, so the resolver
+    models the wire hop cilium-health's real echo takes.
+
+    ``resolve_datapath``: ``ip -> Datapath`` callable, or a plain dict
+    (unknown address = unreachable).  The reachability signal is
+    end-to-end: the target's step must answer ICMP6_ECHO_REPLY AND the
+    synthesized reply bytes (datapath/icmp6.echo_reply — the
+    responder's wire output) must parse back with a valid checksum.
+    Non-ICMP kinds and v4 addresses answer (True, 0.0) so a caller
+    can layer this over another probe_fn."""
+    import numpy as np
+
+    from .compiler.lpm import ipv6_to_words
+    from .datapath.engine import make_full_batch6
+    from .datapath.events import ICMP6_ECHO_REPLY
+    from .datapath.icmp6 import echo_reply, parse_icmp6
+
+    if hasattr(resolve_datapath, "get"):
+        mapping = resolve_datapath
+        resolve_datapath = mapping.get
+
+    def probe(kind: str, ip: str):
+        if kind != PROBE_ICMP or ":" not in ip:
+            return True, 0.0
+        dp = resolve_datapath(ip)
+        if dp is None:
+            return False, 0.0
+        t0 = time.time()
+        batch = make_full_batch6(
+            endpoint=[0], saddr=[src_ip6], daddr=[ip],
+            sport=[0], dport=[0], direction=[1], proto=[58],
+            icmp_type=[128])
+        _v, event, _i, _n = dp.process6(batch)
+        if int(np.asarray(event)[0]) != ICMP6_ECHO_REPLY:
+            return False, time.time() - t0
+        # consume the responder's synthesized reply like the wire
+        # delivered it back to the prober
+        reply = parse_icmp6(echo_reply(
+            ipv6_to_words(ip), ipv6_to_words(src_ip6),
+            ident=0, seq=0))
+        ok = reply["type"] == 129 and reply["checksum_ok"] and \
+            reply["dst_words"] == list(ipv6_to_words(src_ip6))
+        return ok, time.time() - t0
+
+    return probe
+
+
 class HealthProber:
     """Periodic prober over the node set.
 

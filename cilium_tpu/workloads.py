@@ -259,12 +259,17 @@ class DockerEventWatcher:
         self._thread.start()
         return self
 
-    def stop(self, timeout: float = 5.0) -> None:
+    def stop(self, timeout: Optional[float] = None) -> None:
         self._stop.set()
         with self._conn_lock:
             if self._conn is not None:
                 teardown_http_conn(self._conn)
-        self._thread.join(timeout=timeout)
+        # the thread may be inside a list/inspect call on its own
+        # connection (bounded by client.timeout) — wait that out, and
+        # the sink calls re-check _stop so a stalled dockerd can't
+        # drive endpoint churn after stop() returns
+        self._thread.join(timeout=self.client.timeout + 2.0
+                          if timeout is None else timeout)
 
     def _register(self, conn) -> None:
         with self._conn_lock:
@@ -318,9 +323,24 @@ class DockerEventWatcher:
                             meta = _container_meta(
                                 self.client.inspect(cid))
                         except DockerError:
-                            continue  # raced a fast die
+                            # transient inspect failure (timeout, or
+                            # raced a fast die): fall back to the
+                            # event's own Actor.Attributes — docker
+                            # carries the container labels there —
+                            # rather than leaving the container
+                            # endpoint-less until the next resync
+                            attrs = dict((ev.get("Actor") or {})
+                                         .get("Attributes") or {})
+                            name = attrs.pop("name", cid[:12])
+                            attrs.pop("image", None)
+                            meta = {"id": cid, "name": name,
+                                    "labels": attrs}
+                        if self._stop.is_set():
+                            break
                         self.sink.on_start(meta)
                     elif action in ("die", "stop", "destroy"):
+                        if self._stop.is_set():
+                            break
                         self.sink.on_stop(cid)
             except DockerError:
                 failures += 1

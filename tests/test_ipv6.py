@@ -203,6 +203,128 @@ def test_v6_overlay_decap_identity():
     assert np.asarray(verdict)[0] == 0
 
 
+# ------------------------------------------------- ICMPv6 / NDP stage
+
+ROUTER6 = "f00d::1"
+
+
+def _dp6_icmp():
+    dp = _dp6()
+    dp.set_router_ip6(ROUTER6)
+    return dp
+
+
+def test_icmp6_ns_for_router_answered_ns_for_other_dropped():
+    """bpf/lib/icmp6.h __icmp6_handle_ns: NS targeting ROUTER_IP is
+    answered with an NA; NS for any other target drops
+    (ACTION_UNKNOWN_ICMP6_NS)."""
+    from cilium_tpu.datapath.events import (DROP_UNKNOWN_TARGET,
+                                            ICMP6_NS_REPLY)
+    dp = _dp6_icmp()
+    batch = make_full_batch6(
+        endpoint=[0, 0],
+        saddr=["2001:db8:7::5"] * 2, daddr=["ff02::1:ff00:1"] * 2,
+        sport=[0, 0], dport=[0, 0], direction=[1, 1], proto=[58, 58],
+        icmp_type=[135, 135],
+        nd_target=[ROUTER6, "2001:db8:7::99"])
+    verdict, event, _i, _n = dp.process6(batch, now=50)
+    verdict, event = np.asarray(verdict), np.asarray(event)
+    assert verdict[0] == 0 and event[0] == ICMP6_NS_REPLY
+    assert verdict[1] < 0 and event[1] == DROP_UNKNOWN_TARGET
+
+
+def test_icmp6_echo_to_router_answered_echo_to_peer_polices():
+    """Echo request to the router answers locally (terminal action);
+    echo to anything else flows through policy like normal traffic —
+    here no ICMPv6 rule exists, so it drops."""
+    from cilium_tpu.datapath.events import ICMP6_ECHO_REPLY
+    dp = _dp6_icmp()
+    batch = make_full_batch6(
+        endpoint=[0, 0],
+        saddr=["2001:db8:7::5"] * 2,
+        daddr=[ROUTER6, "2001:db8:aa::1"],
+        sport=[0, 0], dport=[0, 0], direction=[1, 1], proto=[58, 58],
+        icmp_type=[128, 128])
+    verdict, event, _i, _n = dp.process6(batch, now=50)
+    verdict, event = np.asarray(verdict), np.asarray(event)
+    assert verdict[0] == 0 and event[0] == ICMP6_ECHO_REPLY
+    assert verdict[1] < 0
+
+
+def test_icmp6_answers_do_not_create_ct_state():
+    dp = _dp6_icmp()
+    batch = make_full_batch6(
+        endpoint=[0], saddr=["2001:db8:7::5"], daddr=[ROUTER6],
+        sport=[0], dport=[0], direction=[1], proto=[58],
+        icmp_type=[128])
+    dp.process6(batch, now=50)
+    assert dp.ct_entries()[1] == 0
+
+
+def test_icmp6_prefilter_beats_responder():
+    """XDP runs before bpf_lxc: a prefiltered source's NS is dropped,
+    never answered."""
+    dp = _dp6_icmp()
+    dp.prefilter.insert(["2001:db8:7::/64"],
+                        PrefilterType.PREFIX_DYN_V6)
+    dp.reload_prefilter()
+    batch = make_full_batch6(
+        endpoint=[0], saddr=["2001:db8:7::5"],
+        daddr=["ff02::1:ff00:1"], sport=[0], dport=[0],
+        direction=[1], proto=[58], icmp_type=[135],
+        nd_target=[ROUTER6])
+    verdict, event, _i, _n = dp.process6(batch, now=50)
+    assert np.asarray(verdict)[0] < 0
+    assert np.asarray(event)[0] == DROP_PREFILTER
+
+
+def test_icmp6_health_probe_rides_responder():
+    """v6 health probes ride the echo responder end-to-end: the
+    resolver routes the echo to the datapath owning the address (the
+    wire-hop model), that node's responder answers, and the
+    synthesized reply bytes validate.  Unknown addresses and nodes
+    whose responder doesn't own the address are unreachable."""
+    from cilium_tpu.health import PROBE_ICMP, make_icmp6_probe
+    dp = _dp6_icmp()
+    probe = make_icmp6_probe({ROUTER6: dp}, "2001:db8:7::5")
+    ok, lat = probe(PROBE_ICMP, ROUTER6)
+    assert ok and lat >= 0.0
+    # no node owns this address -> unreachable
+    ok, _ = probe(PROBE_ICMP, "2001:db8:aa::1")
+    assert not ok
+    # a node that does NOT own the probed address can't answer either
+    probe_wrong = make_icmp6_probe(
+        lambda ip: dp, "2001:db8:7::5")
+    ok, _ = probe_wrong(PROBE_ICMP, "2001:db8:aa::1")
+    assert not ok
+    # v4 targets pass through (layered over another probe_fn)
+    assert probe(PROBE_ICMP, "10.0.0.1") == (True, 0.0)
+
+
+def test_icmp6_reply_synthesis_round_trips():
+    """The responder's wire bytes (send_icmp6_ndisc_adv /
+    __icmp6_send_echo_reply analogs): valid checksums, correct types,
+    flags, and addressing."""
+    from cilium_tpu.compiler.lpm import ipv6_to_words
+    from cilium_tpu.datapath.icmp6 import (echo_reply,
+                                           ndisc_advertisement,
+                                           parse_icmp6)
+    router = ipv6_to_words(ROUTER6)
+    peer = ipv6_to_words("2001:db8:7::5")
+    mac = bytes.fromhex("0a1b2c3d4e5f")
+    na = parse_icmp6(ndisc_advertisement(router, peer, router, mac))
+    assert na["type"] == 136 and na["code"] == 0
+    assert na["checksum_ok"]
+    assert na["src_words"] == list(router)
+    assert na["dst_words"] == list(peer)
+    assert na["target_words"] == list(router)
+    assert na["tlla"] == mac
+    er = parse_icmp6(echo_reply(router, peer, ident=77, seq=3,
+                                payload=b"ping"))
+    assert er["type"] == 129 and er["checksum_ok"]
+    assert er["ident"] == 77 and er["seq"] == 3
+
+
 def test_v6_counters_accumulate():
     dp = _dp6()
     before = int(np.asarray(dp.counters.packets).sum())

@@ -336,3 +336,84 @@ def test_policygen_matrix_v6():
         want_v = oracle_verdict(st, want_id, port, 6, INGRESS)
         assert v[i] == want_v, \
             f"{addr}:{port} id={want_id} device {v[i]} oracle {want_v}"
+
+
+def test_policygen_matrix_v6_icmp6():
+    """ICMPv6 rows woven into a generated v6 matrix: NS/echo for the
+    router answer locally regardless of policy, NS for other targets
+    drop, and every other ICMPv6 flow gets the oracle's verdict for
+    (identity, 0, 58) — the reference polices ICMPv6 at the L3/proto
+    level (ipv6_policy reads ports only for TCP/UDP)."""
+    import ipaddress
+    from cilium_tpu.compiler.policy_tables import oracle_verdict
+    from cilium_tpu.datapath.engine import Datapath, make_full_batch6
+    from cilium_tpu.datapath.events import (DROP_UNKNOWN_TARGET,
+                                            ICMP6_ECHO_REPLY,
+                                            ICMP6_NS_REPLY)
+    from cilium_tpu.identity import RESERVED_WORLD
+    from cilium_tpu.policy.mapstate import (EGRESS, PolicyKey,
+                                            PolicyMapState,
+                                            PolicyMapStateEntry)
+    rng = np.random.default_rng(23)
+    router = "f00d::1"
+    idents = [800 + i for i in range(4)]
+    prefixes = {f"2001:db8:{i + 1:x}::/64": ident
+                for i, ident in enumerate(idents)}
+
+    st = PolicyMapState()
+    # half the identities may send ICMPv6 (egress proto-58 rows);
+    # a couple of TCP rows make sure families don't cross-match
+    for ident in idents[:2]:
+        st[PolicyKey(identity=ident, dest_port=0, nexthdr=58,
+                     direction=EGRESS)] = PolicyMapStateEntry()
+    st[PolicyKey(identity=idents[2], dest_port=443, nexthdr=6,
+                 direction=EGRESS)] = PolicyMapStateEntry()
+
+    dp = Datapath(ct_slots=1 << 10, ct_probe=4)
+    dp.load_policy([st], revision=1, ipcache_prefixes={})
+    dp.load_ipcache6(prefixes)
+    dp.set_router_ip6(router)
+
+    flows = []   # (daddr, icmp_type, nd_target, kind)
+    for k in range(60):
+        dst_pick = list(prefixes)[rng.integers(0, len(prefixes))]
+        dst = str(ipaddress.ip_network(dst_pick).network_address +
+                  int(rng.integers(1, 999)))
+        roll = rng.random()
+        if roll < 0.2:
+            flows.append((router, 135, router, "ns-router"))
+        elif roll < 0.4:
+            flows.append((dst, 135, dst, "ns-other"))
+        elif roll < 0.6:
+            flows.append((router, 128, "::", "echo-router"))
+        else:
+            flows.append((dst, 128, "::", "echo-peer"))
+
+    batch = make_full_batch6(
+        endpoint=[0] * len(flows),
+        saddr=["2001:db8:ff::9"] * len(flows),
+        daddr=[f[0] for f in flows],
+        sport=[0] * len(flows), dport=[0] * len(flows),
+        direction=[1] * len(flows),
+        proto=[58] * len(flows),
+        icmp_type=[f[1] for f in flows],
+        nd_target=[f[2] for f in flows])
+    verdict, event, identity, _n = dp.process6(batch, now=50)
+    v, ev = np.asarray(verdict), np.asarray(event)
+    ids = np.asarray(identity)
+    from cilium_tpu.compiler.lpm import LPM_MISS, oracle_lpm
+    for i, (dst, typ, _t, kind) in enumerate(flows):
+        if kind == "ns-router":
+            assert v[i] == 0 and ev[i] == ICMP6_NS_REPLY, (i, kind)
+        elif kind == "ns-other":
+            assert v[i] < 0 and ev[i] == DROP_UNKNOWN_TARGET, (i, kind)
+        elif kind == "echo-router":
+            assert v[i] == 0 and ev[i] == ICMP6_ECHO_REPLY, (i, kind)
+        else:
+            lid = oracle_lpm(prefixes, dst)
+            want_id = RESERVED_WORLD if lid == LPM_MISS else lid
+            assert ids[i] == want_id, (dst, ids[i], want_id)
+            want_v = oracle_verdict(st, want_id, 0, 58, EGRESS)
+            assert v[i] == want_v, \
+                f"{kind} {dst} id={want_id} device {v[i]} " \
+                f"oracle {want_v}"
