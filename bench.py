@@ -280,7 +280,8 @@ def run_bench():
                                              330))
     try:
         import bench_suite
-        for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn"):
+        for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
+                     "capacity"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"
                 continue

@@ -57,35 +57,44 @@ def _result(metric, value, unit, target, extra):
             "extra": extra}
 
 
+def _make_policy_tables(rng, n_endpoints: int, entries_per_ep: int):
+    """Shared at-scale policy-table construction for the identity-l4
+    and capacity configs: random identities, ports distinct within
+    each endpoint (stride coprime to 65535) so (identity, port) keys
+    satisfy the bucket builder's uniqueness precondition, INGRESS
+    meta packing.  Entries are built as flat arrays (the vectorized
+    compiler path); generating millions of Python rule objects would
+    be harness cost, not framework cost.
+    Returns (ident [E, R], meta [E, R], ep_col, tables, build_s)."""
+    import time as _time
+    from cilium_tpu.compiler.bucket_tables import build_bucket_tables
+    ident = rng.integers(256, 1 << 22,
+                         (n_endpoints, entries_per_ep)).astype(np.uint32)
+    ports = 1 + (np.arange(entries_per_ep, dtype=np.uint32)[None, :] * 61
+                 + rng.integers(0, 65535, (n_endpoints, 1))) % 65535
+    meta = ((ports << 16) | (6 << 8) | (0 << 1) | 1).astype(
+        np.uint32)  # INGRESS
+    ep_col = np.repeat(np.arange(n_endpoints, dtype=np.int64),
+                       entries_per_ep)
+    t0 = _time.perf_counter()
+    tables = build_bucket_tables(
+        ep_col, ident.ravel(), meta.ravel(),
+        np.zeros(n_endpoints * entries_per_ep, np.int32),
+        num_endpoints=n_endpoints, revision=1)
+    return ident, meta, ep_col, tables, _time.perf_counter() - t0
+
+
 def bench_identity_l4(on_accel: bool):
     """Config 2: identity-label L4 ingress at FULL BASELINE scale —
     10k endpoints x 1k rules on the accelerator (policymap.go:37's
     16,384-entry maps, 10M entries total), via the constant-probe
-    two-choice bucket engine (ops/bucket_ops.py).  Entries are built as
-    flat arrays (the vectorized compiler path); generating 10M Python
-    rule objects is harness cost, not framework cost."""
-    import time as _time
-    from cilium_tpu.compiler.bucket_tables import build_bucket_tables
+    two-choice bucket engine (ops/bucket_ops.py)."""
     from cilium_tpu.ops.bucket_ops import BucketVerdictEngine
     rng = np.random.default_rng(3)
     n_endpoints = 10_000 if on_accel else 512
     rules_per_ep = 1000 if on_accel else 200
-    ident = rng.integers(256, 1 << 22,
-                         (n_endpoints, rules_per_ep)).astype(np.uint32)
-    # ports distinct within each endpoint (stride coprime to 65535), so
-    # (identity, port) keys satisfy the builder's uniqueness precondition
-    ports = 1 + (np.arange(rules_per_ep, dtype=np.uint32)[None, :] * 61 +
-                 rng.integers(0, 65535, (n_endpoints, 1))) % 65535
-    meta = ((ports << 16) | (6 << 8) | (0 << 1) | 1).astype(
-        np.uint32)  # INGRESS
-    ep_col = np.repeat(np.arange(n_endpoints, dtype=np.int64),
-                       rules_per_ep)
-    t0 = _time.perf_counter()
-    tables = build_bucket_tables(
-        ep_col, ident.ravel(), meta.ravel(),
-        np.zeros(n_endpoints * rules_per_ep, np.int32),
-        num_endpoints=n_endpoints, revision=1)
-    build_s = _time.perf_counter() - t0
+    ident, meta, ep_col, tables, build_s = _make_policy_tables(
+        rng, n_endpoints, rules_per_ep)
     eng = BucketVerdictEngine(tables)
     batch = (1 << 20) if on_accel else (1 << 16)
     # half the traffic hits installed exact keys, half misses
@@ -223,11 +232,106 @@ def bench_fqdn(on_accel: bool):
            "p99_batch_latency_us": round(p99, 1)})
 
 
+def bench_capacity(on_accel: bool):
+    """Reference-capacity proof: 16,384 policy entries/endpoint
+    (pkg/maps/policymap/policymap.go:37) x 512 endpoints (8.39M
+    entries) PLUS a 512,000-entry ipcache (pkg/maps/ipcache/
+    ipcache.go:36) resident on device TOGETHER, with the measured step
+    running the real two-stage path: ipcache LPM identity resolution
+    feeding the policy verdict.  Reports build times, device bytes,
+    and verdicts/s at that scale.  (CPU smoke runs scaled down; the
+    capacity claim is the on-accel row.)"""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.compiler.lpm import compile_lpm
+    from cilium_tpu.ops.bucket_ops import BucketVerdictEngine
+    from cilium_tpu.ops.lpm_ops import lpm_lookup
+
+    rng = np.random.default_rng(9)
+    n_endpoints = 512 if on_accel else 64
+    entries_per_ep = 16_384 if on_accel else 2_048
+    n_ipcache = 512_000 if on_accel else 65_536
+
+    # ---- policy tables at full per-endpoint map capacity ----
+    ident, meta, ep_col, tables, policy_build_s = _make_policy_tables(
+        rng, n_endpoints, entries_per_ep)
+    eng = BucketVerdictEngine(tables)
+
+    # ---- ipcache at reference capacity: /32 pod entries + CIDRs ----
+    # unique /32s from a shuffled 10.x space, plus /16 + /24 ranges
+    n32 = n_ipcache - 2048
+    addrs = (np.uint32(0x0A000000) +
+             rng.choice(np.uint32(1 << 24), n32, replace=False)) \
+        .astype(np.uint32)
+    prefixes = {}
+    for a in addrs:
+        prefixes[f"{a >> 24}.{(a >> 16) & 255}.{(a >> 8) & 255}"
+                 f".{a & 255}/32"] = int(256 + (a % (1 << 22)))
+    for i in range(1024):
+        prefixes[f"172.{i % 16 + 16}.{i // 16}.0/24"] = 256 + i
+        prefixes[f"{i % 223 + 1}.{i // 223}.0.0/16"] = 1280 + i
+    t0 = _time.perf_counter()
+    compiled = compile_lpm(prefixes)
+    ipcache_build_s = _time.perf_counter() - t0
+    lpm_dev = tuple(map(jax.device_put, (
+        jnp.asarray(compiled.masks), jnp.asarray(compiled.key_a),
+        jnp.asarray(compiled.key_b), jnp.asarray(compiled.value),
+        jnp.asarray(compiled.prefix_lens))))
+    lpm_bytes = sum(int(np.asarray(a).nbytes) for a in lpm_dev)
+
+    # ---- measured step: LPM identity -> policy verdict ----
+    batch = (1 << 20) if on_accel else (1 << 16)
+    sel = rng.integers(0, ident.size, batch)
+    hit = rng.random(batch) < 0.5
+    saddr = np.where(hit, addrs[rng.integers(0, n32, batch)],
+                     rng.integers(0, 1 << 32, batch).astype(np.uint32)
+                     ).view(np.int32)
+    pep = ep_col[sel].astype(np.int32)
+    pid = ident.ravel()[sel].view(np.int32)
+    dpt = (meta.ravel()[sel] >> 16).astype(np.int32)
+    proto = np.full(batch, 6, np.int32)
+    direction = np.zeros(batch, np.int32)
+    length = np.full(batch, 256, np.int32)
+    saddr, pep, pid, dpt, proto, direction, length = map(
+        jax.device_put, (saddr, pep, pid, dpt, proto, direction,
+                         length))
+    probe = max(1, compiled.max_probe)
+
+    def step():
+        _found, looked_up = lpm_lookup(*lpm_dev, saddr, probe)
+        # resolved identity feeds the verdict for LPM hits; installed
+        # identities exercise the policy stages either way
+        use_id = jnp.where(_found, looked_up, pid)
+        eng(pep, use_id, dpt, proto, direction,
+            length).block_until_ready()
+
+    iters = 20 if on_accel else 3
+    total, p99 = _bench(step, iters, warmup=2)
+    return _result(
+        "capacity_verdicts_per_sec",
+        iters * batch / total, "verdicts/s", 10_000_000.0,
+        {"endpoints": n_endpoints,
+         "entries_per_endpoint": entries_per_ep,
+         "policy_entries": tables.entry_count(),
+         "ipcache_entries": len(prefixes),
+         "policy_build_seconds": round(policy_build_s, 2),
+         "ipcache_build_seconds": round(ipcache_build_s, 2),
+         "policy_device_mbytes": round(eng.nbytes() / 1e6, 1),
+         "ipcache_device_mbytes": round(lpm_bytes / 1e6, 1),
+         "batch": batch, "engine": "lpm+bucket2choice",
+         "p99_batch_latency_us": round(p99, 1),
+         "at_reference_capacity": bool(on_accel)})
+
+
 CONFIGS = {
     "identity-l4": bench_identity_l4,
     "http-regex": bench_http_regex,
     "kafka-acl": bench_kafka_acl,
     "fqdn": bench_fqdn,
+    "capacity": bench_capacity,
 }
 
 

@@ -103,6 +103,22 @@ class Datapath:
                 self._tables6 = self._tables6._replace(
                     router_ip6=self._router_ip6)
 
+    def icmp6_echo_reply_bytes(self, requester_ip6: str,
+                               ident: int = 0, seq: int = 0) -> bytes:
+        """The responder's wire output for an answered echo
+        (icmp6.h __icmp6_send_echo_reply): the reply is built from
+        THIS datapath's programmed router address — the consumer can
+        verify the answer really came from the address it probed."""
+        from .icmp6 import echo_reply
+        from ..compiler.lpm import ipv6_to_words
+        with self._lock:
+            if self._router_ip6 is None:
+                raise RuntimeError("router ip6 not programmed")
+            words = [int(w) for w in
+                     np.asarray(self._router_ip6).view(np.uint32)]
+        return echo_reply(words, ipv6_to_words(requester_ip6),
+                          ident=ident, seq=seq)
+
     # -- table loading -------------------------------------------------------
 
     def load_policy(self, map_states: Sequence[PolicyMapState],

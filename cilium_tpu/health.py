@@ -48,9 +48,11 @@ def make_icmp6_probe(resolve_datapath, src_ip6: str):
 
     ``resolve_datapath``: ``ip -> Datapath`` callable, or a plain dict
     (unknown address = unreachable).  The reachability signal is
-    end-to-end: the target's step must answer ICMP6_ECHO_REPLY AND the
-    synthesized reply bytes (datapath/icmp6.echo_reply — the
-    responder's wire output) must parse back with a valid checksum.
+    end-to-end: the target's step must answer ICMP6_ECHO_REPLY, and
+    the TARGET's own reply synthesis
+    (Datapath.icmp6_echo_reply_bytes, built from the router address
+    the target has programmed — not from this prober's arguments)
+    must parse back addressed from the probed ip to the prober.
     Non-ICMP kinds and v4 addresses answer (True, 0.0) so a caller
     can layer this over another probe_fn."""
     import numpy as np
@@ -58,7 +60,7 @@ def make_icmp6_probe(resolve_datapath, src_ip6: str):
     from .compiler.lpm import ipv6_to_words
     from .datapath.engine import make_full_batch6
     from .datapath.events import ICMP6_ECHO_REPLY
-    from .datapath.icmp6 import echo_reply, parse_icmp6
+    from .datapath.icmp6 import parse_icmp6
 
     if hasattr(resolve_datapath, "get"):
         mapping = resolve_datapath
@@ -78,12 +80,15 @@ def make_icmp6_probe(resolve_datapath, src_ip6: str):
         _v, event, _i, _n = dp.process6(batch)
         if int(np.asarray(event)[0]) != ICMP6_ECHO_REPLY:
             return False, time.time() - t0
-        # consume the responder's synthesized reply like the wire
-        # delivered it back to the prober
-        reply = parse_icmp6(echo_reply(
-            ipv6_to_words(ip), ipv6_to_words(src_ip6),
-            ident=0, seq=0))
+        # consume the TARGET's synthesized reply like the wire
+        # delivered it: its source must be the address we probed
+        # (derived from the target's router state, not our inputs)
+        try:
+            reply = parse_icmp6(dp.icmp6_echo_reply_bytes(src_ip6))
+        except (RuntimeError, AssertionError):
+            return False, time.time() - t0
         ok = reply["type"] == 129 and reply["checksum_ok"] and \
+            reply["src_words"] == list(ipv6_to_words(ip)) and \
             reply["dst_words"] == list(ipv6_to_words(src_ip6))
         return ok, time.time() - t0
 

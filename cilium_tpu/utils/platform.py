@@ -170,14 +170,19 @@ def main_with_fallback(run, timeout: float | None = None,
         return total_budget - (_time.monotonic() - start)
 
     def _emit(stdout_text):
-        """Print the child's JSON with the newest committed on-accel
-        artifact embedded (and persist a new artifact when this very
-        run was on-accel)."""
-        line = stdout_text.strip().splitlines()[-1]
+        """Print the child's output, with the newest committed
+        on-accel artifact embedded into the LAST JSON line (and a new
+        artifact persisted when this very run was on-accel).  Earlier
+        lines pass through verbatim — bench_suite emits one JSON line
+        per config."""
+        lines = stdout_text.strip().splitlines()
+        for prev in lines[:-1]:
+            print(prev)
+        line = lines[-1] if lines else ""
         try:
             parsed = json.loads(line)
         except ValueError:
-            sys.stdout.write(stdout_text)
+            print(line)
             sys.stdout.flush()
             return
         extra = parsed.setdefault("extra", {})

@@ -54,6 +54,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, out)
             return
         if self.path.startswith("/containers/"):
+            if dockerd.fail_inspect:
+                self._json(500, {"message": "dockerd overloaded"})
+                return
             cid = self.path.split("/")[2]
             with dockerd._cond:
                 c = dockerd.containers.get(cid)
@@ -113,6 +116,7 @@ class FakeDockerd:
         self.containers = {}
         self.events = []
         self.epoch = 0  # bump = drop live event streams
+        self.fail_inspect = False  # 500 every /containers/{id}/json
         srv = _UnixHTTPServer(socket_path, _Handler)
         srv.dockerd = self
         self._srv = srv
@@ -272,6 +276,40 @@ def test_stream_drop_resyncs_and_reaps_gap_deaths(dockerd, daemon):
         assert _wait(lambda: w.resyncs > resyncs)
         assert _wait(lambda: sink.endpoint_of(cid) is None), \
             "gap death must be reaped by the reconnect resync"
+    finally:
+        w.stop()
+
+
+def test_inspect_failure_falls_back_to_event_attributes(dockerd,
+                                                        daemon):
+    """A transient inspect failure on a start event must not leave the
+    container endpoint-less: the watcher falls back to the event's
+    Actor.Attributes for name + labels (docker puts container labels
+    there), and meta keys like 'image' don't leak into labels."""
+    sink = WorkloadWatcher(daemon, ipam=daemon.ipam)
+    w = DockerEventWatcher(DockerClient(dockerd.socket_path),
+                           sink).start()
+    try:
+        assert w.synced.wait(10)
+        dockerd.fail_inspect = True
+        cid = "ee" * 32
+        with dockerd._cond:
+            dockerd.containers[cid] = {"name": "fb-1",
+                                       "labels": {"app": "fb"}}
+            dockerd.events.append({
+                "Type": "container", "Action": "start",
+                "Actor": {"ID": cid,
+                          "Attributes": {"name": "fb-1",
+                                         "image": "nginx:1",
+                                         "app": "fb"}}})
+            dockerd._cond.notify_all()
+        assert _wait(lambda: sink.endpoint_of(cid) is not None), \
+            "inspect failure left the container endpoint-less"
+        ep = daemon.endpoints.lookup(sink.endpoint_of(cid))
+        labels = [str(l) for l in ep.labels.to_array()]
+        assert any("app=fb" in l for l in labels)
+        assert not any("image" in l for l in labels), labels
+        assert ep.container_name == "fb-1"
     finally:
         w.stop()
 
