@@ -519,9 +519,28 @@ def cmd_agent(args) -> int:
         # the daemon->TPU verdict-service RPC hop: remote ingest
         # points ship header batches here (verdict_service.py)
         from .verdict_service import VerdictService
+        secret = None
+        if getattr(args, "verdict_secret_file", ""):
+            # config errors are startup errors: a missing or empty
+            # secret file must stop the agent with a clear message,
+            # never degrade into an unauthenticated service
+            try:
+                with open(args.verdict_secret_file, "rb") as f:
+                    secret = f.read().strip()
+            except OSError as e:
+                raise SystemExit(f"--verdict-secret-file: {e}")
+            if not secret:
+                raise SystemExit(f"--verdict-secret-file "
+                                 f"{args.verdict_secret_file!r} is "
+                                 f"empty")
         try:
             vsvc = VerdictService(d.datapath,
-                                  port=args.verdict_port).start()
+                                  host=getattr(args, "verdict_host",
+                                               "127.0.0.1"),
+                                  port=args.verdict_port,
+                                  secret=secret).start()
+        except ValueError as e:
+            raise SystemExit(f"verdict service config: {e}")
         except (RuntimeError, OSError) as e:
             # native build unavailable (g++ missing raises
             # FileNotFoundError) or the port is taken — the agent
@@ -694,6 +713,13 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("--verdict-port", type=int, default=0,
                     help="serve the batch verdict service on this "
                          "port (0 = disabled)")
+    ag.add_argument("--verdict-host", default="127.0.0.1",
+                    help="verdict service bind address; non-loopback "
+                         "requires --verdict-secret-file")
+    ag.add_argument("--verdict-secret-file", default="",
+                    help="file holding the shared secret for verdict-"
+                         "service peer authentication (HMAC "
+                         "challenge-response)")
     ag.add_argument("--kvstore", default="none",
                     help="none | in-memory | remote | etcd")
     ag.add_argument("--kvstore-opt", action="append", default=[],

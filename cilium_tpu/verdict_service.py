@@ -54,6 +54,8 @@ from .utils.netio import recv_exact as _recv_exact
 
 MAGIC_REQ = 0xC111A901
 MAGIC_RESP = 0xC111A902
+MAGIC_AUTH = 0xC111A9A1     # server challenge frame
+MAGIC_AUTH_OK = 0xC111A9A2  # server accept frame
 MAX_COUNT = 1 << 20
 
 
@@ -74,9 +76,26 @@ class VerdictService:
     dispatch)."""
 
     def __init__(self, datapath, host: str = "127.0.0.1", port: int = 0,
-                 max_batch: int = 1 << 15):
+                 max_batch: int = 1 << 15,
+                 secret: "bytes | None" = None):
         from .native import load
         load()  # the ring is mandatory here; fail at construction
+        # Peer authentication: the reference keeps equivalent surfaces
+        # on unix sockets or localhost; a cross-node bind here REQUIRES
+        # a shared secret (challenge-response HMAC on connect) — fail
+        # closed rather than trust the network
+        if secret is not None and not secret:
+            # an empty key is an HMAC any peer can compute — worse
+            # than no auth, because the operator believes auth is on
+            raise ValueError("verdict service secret must be "
+                             "non-empty")
+        if host not in ("127.0.0.1", "localhost", "::1") and \
+                not secret:
+            raise ValueError(
+                f"binding verdict service on {host!r} requires a "
+                f"shared secret (secret=...); only loopback may run "
+                f"unauthenticated")
+        self.secret = secret
         self.datapath = datapath
         self.max_batch = max_batch
         self.frames_served = 0
@@ -97,8 +116,37 @@ class VerdictService:
 
     # ---------------------------------------------------- per-connection
 
+    def _authenticate(self, sock: socket.socket) -> bool:
+        """Challenge-response: send a fresh nonce, require
+        HMAC-SHA256(secret, nonce) back (replay-proof; the secret
+        never crosses the wire).  Constant-time compare."""
+        import hmac as _hmac
+        import os as _os
+        nonce = _os.urandom(16)
+        try:
+            sock.sendall(struct.pack(">I", MAGIC_AUTH) + nonce)
+            answer = _recv_exact(sock, 32)
+        except OSError:
+            return False
+        if answer is None:
+            return False
+        want = _hmac.new(self.secret, nonce, "sha256").digest()
+        if not _hmac.compare_digest(want, answer):
+            return False
+        try:
+            sock.sendall(struct.pack(">I", MAGIC_AUTH_OK))
+        except OSError:
+            return False
+        return True
+
     def _serve_conn(self, sock: socket.socket) -> None:
         from .native import PKT_HEADER_DTYPE, PacketRing
+        if self.secret is not None and not self._authenticate(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
         ring = PacketRing(capacity=1 << 16)
         frames: "deque[Tuple[int, int]]" = deque()  # (frame_id, count)
         frames_lock = threading.Lock()
@@ -259,11 +307,27 @@ class VerdictClient:
     """Blocking client: ship PKT_HEADER_DTYPE record batches, get
     (verdicts, identities) back.  Pipelinable: frame ids correlate."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 secret: "bytes | None" = None):
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
         self._next_id = 0
         self._lock = threading.Lock()
+        if secret is not None:
+            self._handshake(secret)
+
+    def _handshake(self, secret: bytes) -> None:
+        import hmac as _hmac
+        head = _recv_exact(self._sock, 4 + 16)
+        if head is None or \
+                struct.unpack(">I", head[:4])[0] != MAGIC_AUTH:
+            raise VerdictServiceError("expected auth challenge")
+        self._sock.sendall(
+            _hmac.new(secret, head[4:], "sha256").digest())
+        ack = _recv_exact(self._sock, 4)
+        if ack is None or \
+                struct.unpack(">I", ack)[0] != MAGIC_AUTH_OK:
+            raise VerdictServiceError("authentication rejected")
 
     def classify(self, records: np.ndarray
                  ) -> Tuple[np.ndarray, np.ndarray]:

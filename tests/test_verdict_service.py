@@ -193,6 +193,39 @@ def test_agent_verdict_port_flag_parses():
     assert args.verdict_port == 19999
 
 
+def test_peer_auth_challenge_response(wired_daemon):
+    """Round-4 weak #6: the cross-node deployment story needs peer
+    authentication.  With a shared secret, connecting is a
+    challenge-response HMAC handshake: the right secret classifies,
+    the wrong one is rejected before any frame is served, and a
+    non-loopback bind without a secret refuses to start at all."""
+    d, web, db = wired_daemon
+    svc = VerdictService(d.datapath, secret=b"s3cret").start()
+    try:
+        client = VerdictClient("127.0.0.1", svc.port, secret=b"s3cret")
+        recs = _records(db.table_slot, _ip_u32(web.ipv4),
+                        _ip_u32(db.ipv4), sports=[45100],
+                        dports=[5432])
+        v, _ = client.classify(recs)
+        assert int(v[0]) >= 0
+        client.close()
+        # wrong secret: handshake rejected, no frames served
+        with pytest.raises(VerdictServiceError):
+            VerdictClient("127.0.0.1", svc.port, secret=b"wrong")
+        # no secret: the client never answers the challenge; its first
+        # classify cannot succeed (server closes on garbage/eof)
+        bare = VerdictClient("127.0.0.1", svc.port, timeout=5)
+        with pytest.raises(VerdictServiceError):
+            bare.classify(recs)
+        bare.close()
+        assert svc.frames_served == 1
+    finally:
+        svc.shutdown()
+    # fail closed: non-loopback bind without a secret refuses
+    with pytest.raises(ValueError):
+        VerdictService(d.datapath, host="0.0.0.0")
+
+
 def test_client_empty_batch_short_circuits(wired_daemon):
     d, _web, _db = wired_daemon
     svc = VerdictService(d.datapath).start()
