@@ -118,6 +118,14 @@ def cmd_status(c: Client, args) -> int:
            if ctl["consecutive-failure-count"] > 0]
     print(f"Controllers:   {len(st.get('controllers', []))} "
           f"({len(bad)} failing)")
+    tr = st.get("transports")
+    if tr:
+        open_breakers = [n for n, s in tr.get("breakers", {}).items()
+                         if s != "closed"]
+        print(f"Transports:    {tr['retries']} retries, "
+              f"{tr['verify-on-retry']} verified, "
+              f"{tr['watch-relists']} relists, "
+              f"{len(open_breakers)} breakers open")
     return 0
 
 

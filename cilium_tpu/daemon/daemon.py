@@ -51,6 +51,7 @@ from ..utils.metrics import (IDENTITY_COUNT, POLICY_COUNT,
                              POLICY_IMPORT_ERRORS, POLICY_REVISION,
                              PROXY_REDIRECTS, registry as metrics_registry)
 from ..utils.option import DaemonConfig, parse_option_value
+from ..utils import resilience as transport_resilience
 from ..utils.trigger import Trigger
 from ..compiler.lpm import ipv4_to_u32
 
@@ -816,6 +817,10 @@ class Daemon:
             "proxy": {"redirects": len(self.proxy)},
             "clustermesh": self.clustermesh.status(),
             "controllers": self.controllers.status_model(),
+            # breaker/retry/relist counters from the transport
+            # resilience layer (utils/resilience.py) — the same series
+            # /metrics exposes, summarized for the status path
+            "transports": transport_resilience.status_summary(),
             "datapath": {"revision": self.datapath.revision,
                          "conntrack-slots": self.datapath.ct.slots},
             # runtime capability probes (bpf/run_probes.sh analog)

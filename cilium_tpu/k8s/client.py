@@ -41,6 +41,8 @@ class GoneError(Exception):
 
 
 from ..utils.netio import teardown_http_conn as _teardown_conn  # noqa: E402
+from ..utils.resilience import (CircuitBreaker,  # noqa: E402
+                                WATCH_RELISTS)
 
 
 class K8sClient:
@@ -129,13 +131,20 @@ class Reflector:
 
     def __init__(self, client: K8sClient, path: str, kind: str,
                  watcher, backoff_base: float = 0.05,
-                 backoff_max: float = 2.0):
+                 backoff_max: float = 2.0,
+                 breaker: Optional[CircuitBreaker] = None):
         self.client = client
         self.path = path
         self.kind = kind
         self.watcher = watcher
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
+        # a flapping apiserver degrades to the breaker's bounded probe
+        # cadence instead of a reconnect hot loop
+        self.breaker = breaker or CircuitBreaker(
+            f"k8s-watch-{kind}", failure_threshold=3,
+            reset_timeout=max(backoff_base * 4, 0.1),
+            max_reset=max(backoff_max, 5.0))
         self._stop = threading.Event()
         self._conn_lock = threading.Lock()
         self._conn: Optional[http.client.HTTPConnection] = None
@@ -181,6 +190,7 @@ class Reflector:
     def _relist(self) -> str:
         items, rv = self.client.list(self.path)
         self.relists += 1
+        WATCH_RELISTS.inc(labels={"transport": "k8s"})
         fresh = {self._key(o): o for o in items}
         # Replace semantics: everything current is an upsert (the
         # watcher's resourceVersion dedup drops no-ops), everything
@@ -199,14 +209,20 @@ class Reflector:
         failures = 0
         rv: Optional[str] = None
         while not self._stop.is_set():
+            if not self.breaker.allow():
+                # open: one probe per bounded interval, nothing else
+                self._stop.wait(max(self.breaker.retry_in(), 0.02))
+                continue
             try:
                 if rv is None:
                     rv = self._relist()
+                    self.breaker.record_success()
                 self.rewatches += 1
                 for etype, obj in self.client.watch(
                         self.path, rv, register=self._register_conn):
                     if self._stop.is_set():
                         break
+                    self.breaker.record_success()
                     action = etype.lower()
                     if action not in ("added", "modified", "deleted"):
                         continue  # e.g. BOOKMARK
@@ -224,6 +240,7 @@ class Reflector:
                 # clean stream end: re-watch from the last version
             except GoneError:
                 # compacted: full relist is the ONLY correct recovery
+                # (not a transport failure — the breaker stays closed)
                 rv = None
             except AttributeError:
                 # http.client nulls resp.fp when stop() closes the
@@ -235,6 +252,7 @@ class Reflector:
                 # HTTPException covers NotConnected from a conn the
                 # stop path tore down (auto_open cleared) and
                 # IncompleteRead from a stream cut mid-chunk
+                self.breaker.record_failure()
                 failures += 1
                 self._stop.wait(min(self.backoff_base * (2 ** failures),
                                     self.backoff_max))

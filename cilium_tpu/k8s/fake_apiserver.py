@@ -225,6 +225,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             while True:
                 with fake._cond:
+                    idle = False
                     while True:
                         if fake._watch_epoch != epoch:
                             raise ConnectionAbortedError
@@ -234,7 +235,19 @@ class _Handler(BaseHTTPRequestHandler):
                             if res == resource and rv > cursor]
                         if pending:
                             break
-                        fake._cond.wait(timeout=0.5)
+                        if not fake._cond.wait(timeout=0.5):
+                            idle = True
+                            break
+                    rv_now = fake._rv
+                if idle:
+                    # heartbeat on idle ticks (watch BOOKMARK analog,
+                    # mirroring mini_etcd's progress notify): the
+                    # write is what surfaces an abandoned client as
+                    # BrokenPipeError so this handler thread exits
+                    # instead of spinning on cond.wait forever
+                    self._chunk({"type": "BOOKMARK", "object": {
+                        "metadata": {"resourceVersion": str(rv_now)}}})
+                    continue
                 for rv, etype, obj in pending:
                     self._chunk({"type": etype, "object": obj})
                     cursor = rv

@@ -23,6 +23,9 @@ import uuid
 from typing import Dict, Optional
 
 from ..utils.netio import teardown_http_conn
+from ..utils.resilience import (SYNTHETIC_EVENTS, TRANSPORT_DEADLINES,
+                                TRANSPORT_RETRIES, TRANSPORT_VERIFIES,
+                                WATCH_RELISTS, AmbiguousResult, Deadline)
 from .backend import (BackendOperations, EVENT_CREATE, EVENT_DELETE,
                       EVENT_LIST_DONE, EVENT_MODIFY, Event, KVLockError,
                       Lock, Watcher, register_backend)
@@ -52,6 +55,22 @@ def _prefix_range_end(prefix: bytes) -> bytes:
 
 class EtcdError(RuntimeError):
     pass
+
+
+class EtcdAmbiguousError(EtcdError, AmbiguousResult):
+    """The connection died after the request was delivered: the op may
+    or may not have been applied.  Raised only for non-idempotent
+    paths (txn CAS) — callers verify by reading the result back."""
+
+
+# Paths whose effect is NOT idempotent: a lost reply after a delivered
+# request leaves the outcome unknown, and a blind re-send of the txn
+# CAS would report succeeded=false against the caller's OWN first
+# write.  Everything else retries blindly: range/keepalive are pure
+# reads, put/deleterange converge to the same state on re-apply, and
+# grant/revoke leak at most one TTL-bounded lease.
+_NON_IDEMPOTENT_PATHS = frozenset({"/v3/kv/txn"})
+_CALL_ATTEMPTS = 3
 
 
 class EtcdBackend(BackendOperations):
@@ -85,11 +104,18 @@ class EtcdBackend(BackendOperations):
     def _call(self, path: str, body: Dict) -> Dict:
         """One request over a persistent keep-alive connection (the
         lock hot path polls; a connect/close per op would churn
-        ephemeral ports).  One transparent reconnect-and-retry on a
-        dead connection."""
+        ephemeral ports).  Idempotent paths get bounded
+        reconnect-and-retry under a deadline; a non-idempotent path
+        (txn CAS) whose connection dies AFTER the request was sent
+        surfaces EtcdAmbiguousError instead — the caller must verify
+        the outcome, never blind-resend."""
         payload = json.dumps(body).encode()
+        idempotent = path not in _NON_IDEMPOTENT_PATHS
+        deadline = Deadline(self.timeout)
+        attempt = 0
         with self._conn_mu:
-            for attempt in (0, 1):
+            while True:
+                sent = False
                 if self._conn is None:
                     self._conn = http.client.HTTPConnection(
                         self.host, self.port, timeout=self.timeout)
@@ -97,6 +123,7 @@ class EtcdBackend(BackendOperations):
                     self._conn.request(
                         "POST", path, body=payload,
                         headers={"Content-Type": "application/json"})
+                    sent = True
                     resp = self._conn.getresponse()
                     data = resp.read()
                     status = resp.status
@@ -104,8 +131,18 @@ class EtcdBackend(BackendOperations):
                 except (OSError, http.client.HTTPException) as e:
                     self._conn.close()
                     self._conn = None
-                    if attempt:
+                    attempt += 1
+                    if sent and not idempotent:
+                        raise EtcdAmbiguousError(f"{path}: {e}") from e
+                    if attempt >= _CALL_ATTEMPTS or deadline.expired:
+                        if deadline.expired:
+                            TRANSPORT_DEADLINES.inc(
+                                labels={"transport": "etcd"})
                         raise EtcdError(f"{path}: {e}") from e
+                    TRANSPORT_RETRIES.inc(
+                        labels={"transport": "etcd", "op": path})
+                    time.sleep(min(0.02 * (2 ** (attempt - 1)),
+                                   deadline.remaining()))
         if status != 200:
             raise EtcdError(f"{path}: HTTP {status}")
         try:
@@ -170,18 +207,33 @@ class EtcdBackend(BackendOperations):
     def create_only(self, key: str, value: bytes,
                     lease: bool = False) -> bool:
         # etcd.go CreateOnly: compare create_revision == 0 (absent)
-        return self._txn_put_if(
-            {"key": _b64e(key), "target": "CREATE",
-             "result": "EQUAL", "create_revision": "0"},
-            key, value, lease)
+        try:
+            return self._txn_put_if(
+                {"key": _b64e(key), "target": "CREATE",
+                 "result": "EQUAL", "create_revision": "0"},
+                key, value, lease)
+        except EtcdAmbiguousError:
+            # verify-on-retry: value equality is the idempotency test.
+            # Callers that need exact ownership (lock_path) write a
+            # unique per-request token as the value, so "our value is
+            # there" can only mean our create landed.  A failed read
+            # here propagates EtcdError: the outcome stays unknown.
+            TRANSPORT_VERIFIES.inc(
+                labels={"transport": "etcd", "op": "create_only"})
+            return self.get(key) == value
 
     def create_if_exists(self, cond_key: str, key: str, value: bytes,
                          lease: bool = False) -> bool:
         # compare cond_key's create_revision > 0 (present)
-        return self._txn_put_if(
-            {"key": _b64e(cond_key), "target": "CREATE",
-             "result": "GREATER", "create_revision": "0"},
-            key, value, lease)
+        try:
+            return self._txn_put_if(
+                {"key": _b64e(cond_key), "target": "CREATE",
+                 "result": "GREATER", "create_revision": "0"},
+                key, value, lease)
+        except EtcdAmbiguousError:
+            TRANSPORT_VERIFIES.inc(
+                labels={"transport": "etcd", "op": "create_if_exists"})
+            return self.get(key) == value
 
     # ------------------------------------------------ listing/watching
 
@@ -201,14 +253,51 @@ class EtcdBackend(BackendOperations):
         rev = int(out.get("header", {}).get("revision", "0"))
         return out.get("kvs", []), rev
 
-    def _watch_stream(self, watcher: Watcher, start_rev: int) -> None:
+    def _relist_into(self, watcher: Watcher, known: set) -> int:
+        """Compaction recovery: relist the prefix, diff against the
+        consumer-visible key set, and emit synthetic MODIFY/DELETE
+        events (the reflector Replace semantics of k8s/client.py) so a
+        consumer can never retain an entry deleted in the blind
+        window.  Returns the revision to resume the watch from."""
+        kvs, rev = self._snapshot(watcher.prefix)
+        WATCH_RELISTS.inc(labels={"transport": "etcd"})
+        fresh: Dict[str, bytes] = {}
+        for kv in kvs:
+            fresh[_b64d(kv["key"]).decode()] = \
+                _b64d(kv.get("value", ""))
+        for key, value in fresh.items():
+            typ = EVENT_MODIFY if key in known else EVENT_CREATE
+            watcher._emit(Event(typ, key, value))
+            SYNTHETIC_EVENTS.inc(
+                labels={"transport": "etcd", "typ": typ})
+        for key in sorted(known - fresh.keys()):
+            watcher._emit(Event(EVENT_DELETE, key))
+            SYNTHETIC_EVENTS.inc(
+                labels={"transport": "etcd", "typ": EVENT_DELETE})
+        known.clear()
+        known.update(fresh)
+        return rev + 1
+
+    def _watch_stream(self, watcher: Watcher, start_rev: int,
+                      known: set) -> None:
         """Reader thread: one /v3/watch stream, re-established from the
         last delivered revision on stream loss; CREATE vs MODIFY from
-        kv.version (1 = first write, etcd semantics)."""
+        kv.version (1 = first write, etcd semantics).  ``known`` is
+        the consumer-visible key set, maintained here so compaction
+        recovery can relist-and-diff instead of dropping events."""
         prefix = watcher.prefix.encode()
-        cursor = start_rev
+        cursor: Optional[int] = start_rev  # None => compacted: relist
         while not self._closed.is_set() and \
                 not watcher._stopped.is_set():
+            if cursor is None:
+                try:
+                    cursor = self._relist_into(watcher, known)
+                except EtcdError:
+                    if self._closed.is_set() or \
+                            watcher._stopped.is_set():
+                        return
+                    time.sleep(0.05)
+                continue
             conn = http.client.HTTPConnection(self.host, self.port,
                                               timeout=self.timeout)
             try:
@@ -235,21 +324,23 @@ class EtcdBackend(BackendOperations):
                     msg = json.loads(line)
                     result = msg.get("result", {})
                     if msg.get("error") or "compact_revision" in result:
-                        # compacted: resync would need a relist; the
-                        # kvstore consumers (allocator caches) tolerate
-                        # restart-from-now
-                        cursor = 0
+                        # compacted: the only lossless recovery is a
+                        # relist-and-diff against the consumer-visible
+                        # set, resuming from the fresh revision
+                        cursor = None
                         break
                     events = result.get("events", [])
                     for ev in events:
                         kv = ev.get("kv", {})
                         key = _b64d(kv.get("key", "")).decode()
                         if ev.get("type") == "DELETE":
+                            known.discard(key)
                             watcher._emit(Event(EVENT_DELETE, key))
                         else:
                             typ = EVENT_CREATE \
                                 if kv.get("version") == "1" \
                                 else EVENT_MODIFY
+                            known.add(key)
                             watcher._emit(Event(
                                 typ, key,
                                 _b64d(kv.get("value", ""))))
@@ -282,13 +373,17 @@ class EtcdBackend(BackendOperations):
         return int(out.get("header", {}).get("revision", "0"))
 
     def watch(self, prefix: str) -> Watcher:
-        watcher, t = self._make_watcher(prefix, self._revision() + 1)
+        watcher, t = self._make_watcher(prefix, self._revision() + 1,
+                                        set())
         t.start()
         return watcher
 
     def list_and_watch(self, prefix: str) -> Watcher:
         kvs, rev = self._snapshot(prefix)
-        watcher, t = self._make_watcher(prefix, rev + 1)
+        # seed the consumer-visible set with the listed keys: they are
+        # what compaction recovery must diff deletions against
+        known = {_b64d(kv["key"]).decode() for kv in kvs}
+        watcher, t = self._make_watcher(prefix, rev + 1, known)
         for kv in kvs:
             watcher._emit(Event(EVENT_CREATE,
                                 _b64d(kv["key"]).decode(),
@@ -299,11 +394,12 @@ class EtcdBackend(BackendOperations):
         t.start()
         return watcher
 
-    def _make_watcher(self, prefix: str, start_rev: int
+    def _make_watcher(self, prefix: str, start_rev: int, known: set
                       ) -> "tuple[Watcher, threading.Thread]":
         watcher = Watcher(prefix, self)
         t = threading.Thread(target=self._watch_stream,
-                             args=(watcher, start_rev), daemon=True,
+                             args=(watcher, start_rev, known),
+                             daemon=True,
                              name=f"etcd-watch-{prefix}")
         with self._lock:
             self._watchers[watcher] = t
@@ -321,7 +417,11 @@ class EtcdBackend(BackendOperations):
     def lock_path(self, path: str, timeout: float = 30.0) -> Lock:
         """Lease-bound lock via atomic create (etcd.go LockPath via
         concurrency.Mutex; same liveness: holder death releases it
-        when the lease expires)."""
+        when the lease expires).  The token doubles as the
+        idempotency token: if the create txn's reply is lost,
+        create_only reads the key back and value==own-token means the
+        lock is ours — a reset mid-acquisition can no longer orphan
+        the lock until its lease expires."""
         token = uuid.uuid4().hex
         lock_key = f"{path}.lock"
         deadline = time.monotonic() + timeout
@@ -334,12 +434,19 @@ class EtcdBackend(BackendOperations):
     def _unlock(self, path: str, token: str) -> None:
         # delete only OUR lock (compare value == token), atomically —
         # never a successor's
-        self._call("/v3/kv/txn", {
+        body = {
             "compare": [{"key": _b64e(f"{path}.lock"),
                          "target": "VALUE", "result": "EQUAL",
                          "value": _b64e(token)}],
             "success": [{"request_delete_range":
-                         {"key": _b64e(f"{path}.lock")}}]})
+                         {"key": _b64e(f"{path}.lock")}}]}
+        try:
+            self._call("/v3/kv/txn", body)
+        except EtcdAmbiguousError:
+            # delete-if-value==token is naturally idempotent: if the
+            # first send applied, the re-sent compare fails against an
+            # absent key (or a successor's token) and no-ops
+            self._call("/v3/kv/txn", body)
 
     # -------------------------------------------------------- liveness
 

@@ -160,6 +160,17 @@ class MiniEtcd:
         return sorted(k for k in self._kv
                       if key <= k < range_end)
 
+    def compact(self, revision: Optional[int] = None) -> None:
+        """Discard watch-replay history up to ``revision`` (default:
+        everything so far) — the etcd Compact analog.  A watch asking
+        for an older start_revision gets the compacted error and must
+        relist."""
+        with self._cond:
+            rev = self._rev if revision is None else revision
+            self._history = [h for h in self._history if h[0] > rev]
+            self._oldest_rev = rev + 1
+            self._cond.notify_all()
+
     def _reaper(self) -> None:
         while not self._stop.wait(self._reap_interval):
             now = time.monotonic()
@@ -298,7 +309,12 @@ class MiniEtcd:
                                   str(self._oldest_rev)},
                        "error": "required revision has been compacted"}
                 return
-        cursor = max(start_rev - 1, 0)
+            # etcd semantics: start_revision=0 means "from current",
+            # NOT "replay retained history" — replay only happens for
+            # an explicit revision (round-5 ADVICE #1: the old
+            # behavior re-emitted up to HISTORY_LIMIT stale events,
+            # including DELETEs, diverging from real etcd)
+            cursor = self._rev if start_rev == 0 else start_rev - 1
         yield {"result": {"created": True,
                           "header": {"revision": str(self._rev)}}}
         while not stopped():

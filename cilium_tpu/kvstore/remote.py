@@ -15,6 +15,8 @@ import socket
 import threading
 from typing import Dict, Optional
 
+from ..utils.resilience import (TRANSPORT_RETRIES, TRANSPORT_VERIFIES,
+                                Deadline)
 from .backend import (EVENT_LIST_DONE, BackendOperations, Event,
                       KVLockError, Lock, Watcher, register_backend)
 from .server import recv_frame, send_frame
@@ -27,9 +29,23 @@ DEFAULT_TTL = 15.0
 # acquisition — pass an explicit padded _timeout.
 DEFAULT_CALL_TIMEOUT = 30.0
 
+# Ops safe to re-send blindly after a timed-out wait: reads return the
+# same answer, set/delete converge to the same state.  Everything else
+# (CAS creates, lock ops, watch registration, session hello) either
+# double-applies or double-registers on a re-send — those surface the
+# timeout and let the caller verify.
+_IDEMPOTENT_OPS = frozenset({
+    "get", "get_prefix", "list_prefix", "set", "delete",
+    "delete_prefix", "renew_lease", "status"})
+
 
 class RemoteError(RuntimeError):
     pass
+
+
+class RemoteTimeout(RemoteError):
+    """The wait for a response frame expired; the request may still be
+    executing server-side (the connection is not known dead)."""
 
 
 class RemoteBackend(BackendOperations):
@@ -110,8 +126,27 @@ class RemoteBackend(BackendOperations):
 
     def _call(self, op: str, _timeout: Optional[float] = None,
               **args) -> dict:
+        """One request with a deadline.  Idempotent ops split the
+        budget across two attempts: a dropped response frame is
+        recovered at half the budget instead of surfacing as a hard
+        error at the full one.  Non-idempotent ops get exactly one
+        send — their callers verify on RemoteTimeout."""
         if _timeout is None:
             _timeout = self.call_timeout
+        if op not in _IDEMPOTENT_OPS:
+            return self._call_once(op, _timeout, args)
+        deadline = Deadline(_timeout)
+        try:
+            return self._call_once(op, max(0.05, _timeout / 2.0), args)
+        except RemoteTimeout:
+            if self._closed.is_set():
+                raise
+            TRANSPORT_RETRIES.inc(
+                labels={"transport": "remote", "op": op})
+            return self._call_once(op, max(0.05, deadline.remaining()),
+                                   args)
+
+    def _call_once(self, op: str, timeout: float, args: dict) -> dict:
         if self._closed.is_set():
             raise RemoteError("client closed")
         with self._mu:
@@ -127,10 +162,10 @@ class RemoteBackend(BackendOperations):
             with self._mu:
                 self._pending.pop(rid, None)
             raise RemoteError(f"send failed: {e}") from e
-        if not slot["ev"].wait(_timeout):
+        if not slot["ev"].wait(timeout):
             with self._mu:
                 self._pending.pop(rid, None)
-            raise RemoteError(f"{op}: timed out")
+            raise RemoteTimeout(f"{op}: timed out")
         with self._mu:
             self._pending.pop(rid, None)
         resp = slot["resp"]
@@ -167,9 +202,19 @@ class RemoteBackend(BackendOperations):
 
     def create_only(self, key: str, value: bytes,
                     lease: bool = False) -> bool:
-        return self._call("create_only", key=key,
-                          value_b64=self._b64(value),
-                          lease=lease)["created"]
+        try:
+            return self._call("create_only", key=key,
+                              value_b64=self._b64(value),
+                              lease=lease)["created"]
+        except RemoteTimeout:
+            # the CAS may have been applied and only the reply lost —
+            # verify instead of blindly re-sending (which would report
+            # created=False against our own first write)
+            if self._closed.is_set():
+                raise
+            TRANSPORT_VERIFIES.inc(
+                labels={"transport": "remote", "op": "create_only"})
+            return self.get(key) == value
 
     def create_if_exists(self, cond_key: str, key: str, value: bytes,
                          lease: bool = False) -> bool:

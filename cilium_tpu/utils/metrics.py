@@ -61,6 +61,11 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(_lk(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label combination."""
+        with self._lock:
+            return sum(self._values.values())
+
     def expose(self) -> List[str]:
         with self._lock:
             return [f"{self.name}{_fmt_labels(k)} {v}"
@@ -93,6 +98,11 @@ class Gauge(_Metric):
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
         with self._lock:
             return self._values.get(_lk(labels), 0.0)
+
+    def total(self) -> float:
+        """Sum across every label combination."""
+        with self._lock:
+            return sum(self._values.values())
 
     def expose(self) -> List[str]:
         with self._lock:

@@ -64,15 +64,29 @@ class FunctionQueue:
             except queue.Empty:
                 continue
             retries = 0
+            observed = False  # f() completed, or wait_func declined
             while not self._stop.is_set():
                 try:
                     f()
+                    observed = True
                     break
                 except Exception:  # noqa: BLE001 — handler errors are
                     # the caller's to observe via wait_func
                     retries += 1
                     if not wait(retries):
+                        observed = True
                         break
+            if not observed:
+                # stop() raced the dequeue: this item was pulled off
+                # the queue but never (finally) executed, so stop()'s
+                # drain can't see it — issue the give-up call here so
+                # enqueue-time bookkeeping (e.g. the k8s watcher's
+                # recorded resourceVersion) is rolled back, not
+                # silently skipped
+                try:
+                    wait(sys.maxsize)
+                except Exception:  # noqa: BLE001 — discard must finish
+                    pass
             with self._idle:
                 self._pending -= 1
                 if self._pending == 0:

@@ -51,6 +51,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from .utils.netio import recv_exact as _recv_exact
+from .utils.netio import recv_exact_within as _recv_exact_within
 
 MAGIC_REQ = 0xC111A901
 MAGIC_RESP = 0xC111A902
@@ -77,7 +78,9 @@ class VerdictService:
 
     def __init__(self, datapath, host: str = "127.0.0.1", port: int = 0,
                  max_batch: int = 1 << 15,
-                 secret: "bytes | None" = None):
+                 secret: "bytes | None" = None,
+                 handshake_timeout: float = 5.0,
+                 frame_timeout: float = 30.0):
         from .native import load
         load()  # the ring is mandatory here; fail at construction
         # Peer authentication: the reference keeps equivalent surfaces
@@ -98,6 +101,11 @@ class VerdictService:
         self.secret = secret
         self.datapath = datapath
         self.max_batch = max_batch
+        # a silent peer must never pin a server thread: the handshake
+        # runs under a short deadline, and once a frame header
+        # arrives, its payload must follow within frame_timeout
+        self.handshake_timeout = handshake_timeout
+        self.frame_timeout = frame_timeout
         self.frames_served = 0
         self.batches_dispatched = 0
         self._stats_lock = threading.Lock()  # one dispatcher per conn
@@ -119,11 +127,15 @@ class VerdictService:
     def _authenticate(self, sock: socket.socket) -> bool:
         """Challenge-response: send a fresh nonce, require
         HMAC-SHA256(secret, nonce) back (replay-proof; the secret
-        never crosses the wire).  Constant-time compare."""
+        never crosses the wire).  Constant-time compare.  The whole
+        exchange runs under ``handshake_timeout`` — a peer that
+        connects and goes silent is dropped, not a pinned thread —
+        and the deadline is cleared only after MAGIC_AUTH_OK."""
         import hmac as _hmac
         import os as _os
         nonce = _os.urandom(16)
         try:
+            sock.settimeout(self.handshake_timeout)
             sock.sendall(struct.pack(">I", MAGIC_AUTH) + nonce)
             answer = _recv_exact(sock, 32)
         except OSError:
@@ -135,6 +147,7 @@ class VerdictService:
             return False
         try:
             sock.sendall(struct.pack(">I", MAGIC_AUTH_OK))
+            sock.settimeout(None)
         except OSError:
             return False
         return True
@@ -213,7 +226,13 @@ class VerdictService:
                 magic, frame_id, count = struct.unpack(">III", head)
                 if magic != MAGIC_REQ or count == 0 or count > MAX_COUNT:
                     break  # protocol error: drop the connection
-                raw = _recv_exact(sock, count * PKT_HEADER_DTYPE.itemsize)
+                # the header committed the peer to a payload: it must
+                # arrive within the frame deadline (idle BETWEEN
+                # frames stays unbounded — a healthy quiet client is
+                # fine; a half-frame stall is a dead peer)
+                raw = _recv_exact_within(
+                    sock, count * PKT_HEADER_DTYPE.itemsize,
+                    self.frame_timeout)
                 if raw is None:
                     break
                 recs = np.frombuffer(raw, PKT_HEADER_DTYPE)
