@@ -82,7 +82,46 @@ def _progress(stage, **kw):
           flush=True)
 
 
+def _smoke_result():
+    """A full-shaped synthetic result for exercising the output
+    contract (``--smoke``): same keys and realistic sizes as a real
+    run, no jax import, so the driver-contract test (final stdout line
+    parses and is <1.5KB, full result persisted to BENCH_FULL_*.json)
+    runs in milliseconds."""
+    suite = {}
+    for name, v in (("identity-l4", 124_000_000), ("http-regex",
+                    9_500_000), ("kafka-acl", 2_100_000),
+                    ("fqdn", 15_600_000), ("capacity", 14_000_000),
+                    ("incremental", 363)):
+        suite[name] = {"metric": name, "value": v, "unit": "x/s",
+                       "vs_baseline": round(v / 1e7, 3),
+                       "extra": {"batch": 8192, "smoke": True,
+                                 "p99_batch_latency_us": 1000.0,
+                                 "engine_selection":
+                                 {"tag": "stride3-int32-C29",
+                                  "strategy": "stride", "k": 3,
+                                  "dtype": "int32", "classes": 29,
+                                  "states": 96}}}
+    return {"metric": "policy_verdicts_per_sec_config1_100rules",
+            "value": 1_290_000, "unit": "verdicts/s",
+            "vs_baseline": 0.129,
+            "extra": {"smoke": True, "batch": 131072, "engine": "dense",
+                      "backend": "cpu", "on_accel": False,
+                      "device": "TFRT_CPU_0",
+                      "p99_batch_latency_us": 101_000.0,
+                      "small_batch_p99_us": {
+                          "host_cache_p99_us_b256": 33.3,
+                          "host_cache_pinned_p99_us_b256": 34.0,
+                          "device_rt_p99_us_b256": 1800.0},
+                      "latency_under_50us_p99": True,
+                      "latency_under_35us_p99": True,
+                      "suite_configs": suite}}
+
+
 def run_bench():
+    if "--smoke" in sys.argv:
+        print(json.dumps(_smoke_result()))
+        return
     # Honor the platform chosen by the watchdog parent (see main below):
     # the axon sitecustomize overrides JAX_PLATFORMS at interpreter start,
     # so it must be re-applied via jax.config after import.
@@ -104,8 +143,9 @@ def run_bench():
         pass
     _progress("backend", backend=backend, on_accel=on_accel)
 
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
-    if not on_accel and len(sys.argv) <= 1:
+    argv_nums = [a for a in sys.argv[1:] if not a.startswith("--")]
+    batch = int(argv_nums[0]) if argv_nums else 1 << 20
+    if not on_accel and not argv_nums:
         batch = 1 << 17  # CPU smoke runs use a smaller default
 
     states, prefixes = build_config1()
@@ -281,17 +321,19 @@ def run_bench():
     try:
         import bench_suite
         for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
-                     "capacity"):
+                     "capacity", "incremental"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"
                 continue
             try:
                 r = bench_suite.CONFIGS[name](on_accel)
-                suite[name] = {"value": r["value"], "unit": r["unit"],
-                               "vs_baseline": r["vs_baseline"],
-                               "p99_batch_latency_us":
-                               r["extra"].get("p99_batch_latency_us")}
-                _progress("suite", config=name, **suite[name])
+                # the FULL per-config result rides along: the parent
+                # persists it to BENCH_FULL_<ts>.json and prints only
+                # the compact contract line (utils/platform._emit), so
+                # size no longer constrains what's recorded here
+                suite[name] = r
+                _progress("suite", config=name, value=r["value"],
+                          vs_baseline=r["vs_baseline"])
             except Exception as e:  # noqa: BLE001 — partial > nothing
                 suite[name] = f"failed: {e!r}"
                 _progress("suite_failed", config=name, error=repr(e))

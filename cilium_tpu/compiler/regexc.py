@@ -183,6 +183,29 @@ def _eps_closure(nfa: _NFA, states: FrozenSet[int]) -> FrozenSet[int]:
     return frozenset(seen)
 
 
+def byte_equivalence_classes(table: np.ndarray
+                             ) -> Tuple[np.ndarray, np.ndarray]:
+    """Alphabet compression: bytes whose transition columns are
+    identical across every state collapse into one equivalence class.
+
+    Policy regex sets (HTTP methods/paths, FQDN patterns) distinguish
+    few byte groups — typically 10-30 classes out of 256 — so the
+    class-indexed table is ~10x smaller than the byte-indexed one and
+    k-byte stride tables (ops/dfa_engine) stay small enough for fast
+    memory.  This is the table-compression treatment the NFA-on-FPGA
+    line of work uses to keep automata in on-chip RAM.
+
+    Returns ``(class_of, class_table)``: ``class_of`` [256] int32 maps
+    a byte to its class; ``class_table`` [S, C] is the transition table
+    reindexed by class, with ``class_table[s, class_of[b]] ==
+    table[s, b]`` for every byte b.
+    """
+    cols = np.ascontiguousarray(table.T)          # [256, S]
+    uniq, inv = np.unique(cols, axis=0, return_inverse=True)
+    return (inv.reshape(-1).astype(np.int32),
+            np.ascontiguousarray(uniq.T.astype(np.int32)))
+
+
 @dataclass
 class CompiledRegexSet:
     """R regexes in one stacked DFA table.
@@ -199,6 +222,15 @@ class CompiledRegexSet:
 
     def nbytes(self) -> int:
         return self.table.nbytes
+
+    def byte_classes(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Cached (class_of, class_table) — see
+        :func:`byte_equivalence_classes`."""
+        cached = getattr(self, "_byte_classes", None)
+        if cached is None:
+            cached = byte_equivalence_classes(self.table)
+            object.__setattr__(self, "_byte_classes", cached)
+        return cached
 
 
 def compile_regex_set(patterns: Sequence[str],

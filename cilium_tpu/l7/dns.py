@@ -17,11 +17,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-import jax.numpy as jnp
-
 from ..compiler.regexc import compile_regex_set
-from ..ops.dfa_ops import (bucket_cols, bucket_rows, device_dfa_tables,
-                           dfa_match, encode_strings)
+from ..ops.dfa_engine import DFAEngine
+from ..ops.dfa_ops import bucket_cols, bucket_rows, encode_strings
 from ..policy.api import CIDRRule, FQDNSelector, Rule
 
 DNS_POLLER_INTERVAL = 5.0  # reference: dnspoller.go:50 (5s)
@@ -78,14 +76,17 @@ class DNSPolicyEngine:
     """Batched "is this observed DNS name allowed?" matcher over all
     FQDN selectors (the DNS-proxy enforcement point)."""
 
-    def __init__(self, selectors: Sequence[FQDNSelector]):
+    def __init__(self, selectors: Sequence[FQDNSelector],
+                 batch_hint: int = 2048):
         self.selectors = list(selectors)
         self._compiled = compile_regex_set(
             [s.to_regex() for s in self.selectors]) if self.selectors \
             else None
         if self._compiled is not None:
-            self._c_table, self._c_accept, self._c_starts = \
-                device_dfa_tables(self._compiled)
+            # quantized, depth-reduced match engine (ops/dfa_engine);
+            # tables uploaded once at construction
+            self._engine = DFAEngine(self._compiled, MAX_NAME_LEN,
+                                     batch_hint=batch_hint)
         # C++ walker over the same tables for single live lookups
         # (two-tier, like l7/http.py); optional native build
         self._scalar = None
@@ -104,14 +105,20 @@ class DNSPolicyEngine:
         return bucket_rows(bucket_cols(encode_strings(
             [_canon(n) for n in names], MAX_NAME_LEN)))
 
+    def encode_packed(self, names: Sequence[str]):
+        """Host encode INCLUDING the engine's class-map/stride packing
+        (the pipelined host stage); None when no selectors."""
+        data = self.encode(names)
+        return None if data is None else self._engine.encode(data)
+
     def match_device(self, data):
         """[B', R] selector hits on device, no synchronization.
-        Selectorless engines have no device program — use
-        match_encoded, which short-circuits."""
+        Accepts a raw byte block (from encode) or a PackedBatch (from
+        encode_packed).  Selectorless engines have no device program —
+        use match_encoded, which short-circuits."""
         if self._compiled is None:
             raise ValueError("selectorless DNS engine has no device match")
-        return dfa_match(self._c_table, self._c_accept, self._c_starts,
-                         jnp.asarray(data))
+        return self._engine.match(data)
 
     def match_encoded(self, data, n: int) -> np.ndarray:
         """[n, R] selector hits over a pre-encoded block."""
@@ -123,7 +130,35 @@ class DNSPolicyEngine:
         """[B, R] selector hits for a batch of names."""
         if self._compiled is None:
             return np.zeros((len(names), 0), bool)
-        return self.match_encoded(self.encode(names), len(names))
+        return self.match_encoded(self.encode_packed(names), len(names))
+
+    def allowed_pipelined(self, batches: Sequence[Sequence[str]]
+                          ) -> List[np.ndarray]:
+        """Double-buffered dispatch over many name batches: host
+        encode/pack of batch N+1 overlaps batch N's device match; one
+        sync at the end.  Returns one [n] bool array per batch."""
+        inflight = []
+        for names in batches:
+            n = len(names)
+            if self._compiled is None:
+                inflight.append((None, n))
+                continue
+            inflight.append(
+                (self.match_device(self.encode_packed(names)), n))
+        out = []
+        for dev, n in inflight:
+            if dev is None:
+                out.append(np.zeros(n, bool))
+            else:
+                hits = np.asarray(dev)[:n]
+                out.append(hits.any(axis=1) if hits.shape[1] else
+                           np.zeros(n, bool))
+        return out
+
+    def engine_report(self) -> Optional[dict]:
+        """Engine-selection report (bench extras / status)."""
+        return None if self._compiled is None \
+            else self._engine.describe()
 
     def allowed(self, names: Sequence[str]) -> np.ndarray:
         hits = self.match(names)

@@ -27,8 +27,8 @@ import functools
 import jax
 
 from ..compiler.regexc import CompiledRegexSet, compile_regex_set
-from ..ops.dfa_ops import (bucket_cols, bucket_rows, device_dfa_tables,
-                           dfa_match, encode_strings)
+from ..ops.dfa_engine import DFAEngine
+from ..ops.dfa_ops import bucket_cols, bucket_rows, encode_strings
 from ..policy.api import PortRuleHTTP
 
 MAX_REQUEST_LINE = 512
@@ -96,7 +96,8 @@ def _header_block(r: HTTPRequest) -> str:
 class HTTPPolicyEngine:
     """One compiled HTTP rule set (one proxy redirect's policy)."""
 
-    def __init__(self, rules: Sequence[PortRuleHTTP]):
+    def __init__(self, rules: Sequence[PortRuleHTTP],
+                 batch_hint: int = 2048):
         self.rules = list(rules)
         if not self.rules:
             # empty rule set == L7 allow-all (wildcarded redirect)
@@ -105,10 +106,11 @@ class HTTPPolicyEngine:
             return
         self._combined = compile_regex_set(
             [_rule_to_combined_regex(r) for r in self.rules])
-        # device-resident once: re-uploading per check() costs more
+        # quantized, depth-reduced match engine, tables device-resident
+        # once at construction: re-uploading per check() costs more
         # than the match at small batches
-        self._c_table, self._c_accept, self._c_starts = \
-            device_dfa_tables(self._combined)
+        self._eng_c = DFAEngine(self._combined, MAX_REQUEST_LINE,
+                                batch_hint=batch_hint)
         header_patterns: List[str] = []
         self._header_slices: List[Tuple[int, int]] = []
         for r in self.rules:
@@ -118,8 +120,8 @@ class HTTPPolicyEngine:
         self._headers = compile_regex_set(header_patterns) \
             if header_patterns else None
         if self._headers is not None:
-            self._h_table, self._h_accept, self._h_starts = \
-                device_dfa_tables(self._headers)
+            self._eng_h = DFAEngine(self._headers, MAX_HEADER_BLOCK,
+                                    batch_hint=batch_hint)
             # header-pattern -> owning-rule index, device-resident for
             # the on-device AND-combine in check_encoded
             hmap = np.zeros(len(header_patterns), np.int32)
@@ -157,21 +159,35 @@ class HTTPPolicyEngine:
                 [_header_block(r) for r in requests], MAX_HEADER_BLOCK)))
         return data, hdata
 
+    def encode_packed(self, requests: Sequence[HTTPRequest]):
+        """Host encode INCLUDING the engine's class-map/stride packing
+        (ops/dfa_engine.DFAEngine.encode): the returned PackedBatch
+        pair feeds match_device with the smallest possible device
+        program.  This is the pipelined proxy's host stage — packing
+        batch N+1 overlaps the device walk of batch N."""
+        data, hdata = self.encode(requests)
+        if data is None:
+            return None, None
+        packed = self._eng_c.encode(data)
+        hpacked = self._eng_h.encode(hdata) \
+            if self._headers is not None else None
+        return packed, hpacked
+
     def match_device(self, data, hdata):
         """Device verdicts over pre-encoded blocks; [B'] bool on device.
 
-        Does not synchronize: callers can dispatch many batches
-        back-to-back and block once, hiding the host<->device link
-        latency behind in-flight compute.  Allow-all engines have no
-        device program — use check_encoded, which short-circuits."""
+        Accepts raw byte blocks (from encode) or PackedBatch pairs
+        (from encode_packed).  Does not synchronize: callers can
+        dispatch many batches back-to-back and block once, hiding the
+        host<->device link latency behind in-flight compute.  Allow-all
+        engines have no device program — use check_encoded, which
+        short-circuits."""
         if self._combined is None:
             raise ValueError("allow-all HTTP engine has no device match")
-        rule_hit = dfa_match(self._c_table, self._c_accept,
-                             self._c_starts, jnp.asarray(data))  # [B', R]
+        rule_hit = self._eng_c.match(data)               # [B', R]
         if self._headers is None:
             return _any_rule(rule_hit)
-        hdr_hit = dfa_match(self._h_table, self._h_accept,
-                            self._h_starts, jnp.asarray(hdata))  # [B', H]
+        hdr_hit = self._eng_h.match(hdata)               # [B', H]
         return _combine_headers(rule_hit, hdr_hit, self._hmap,
                                 rule_hit.shape[1])
 
@@ -185,8 +201,38 @@ class HTTPPolicyEngine:
         """Batched verdicts: [B] bool (True == allow)."""
         if self._combined is None:
             return np.ones(len(requests), bool)
-        data, hdata = self.encode(requests)
+        data, hdata = self.encode_packed(requests)
         return self.check_encoded(data, hdata, len(requests))
+
+    def check_pipelined(self, batches: Sequence[Sequence[HTTPRequest]]
+                        ) -> List[np.ndarray]:
+        """Double-buffered dispatch over many request batches.
+
+        JAX dispatch is asynchronous, so encoding + packing batch N+1
+        on the host overlaps batch N's device match; all batches are
+        in flight before the single sync at the end — the treatment
+        that took the fqdn path past its bar.  Returns one [n] bool
+        array per input batch."""
+        inflight: List[Tuple[object, int]] = []
+        for reqs in batches:
+            n = len(reqs)
+            if self._combined is None:
+                inflight.append((None, n))
+                continue
+            data, hdata = self.encode_packed(reqs)
+            inflight.append((self.match_device(data, hdata), n))
+        return [np.ones(n, bool) if dev is None else
+                np.asarray(dev)[:n] for dev, n in inflight]
+
+    def engine_report(self) -> Optional[dict]:
+        """Engine-selection report (bench extras / status): which
+        strategy/k/dtype each compiled table runs with."""
+        if self._combined is None:
+            return None
+        out = {"combined": self._eng_c.describe()}
+        if self._headers is not None:
+            out["headers"] = self._eng_h.describe()
+        return out
 
     def check_one(self, request: HTTPRequest) -> bool:
         """One live request — the proxy's per-connection path."""

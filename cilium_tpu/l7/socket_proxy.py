@@ -36,7 +36,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .http import HTTPRequest
 from .kafka import (KafkaParseError, KafkaRequest, parse_kafka_request)
 from .parser import Connection as ParserConnection
-from .parser import Op, REGISTRY, ParserRegistry
+from .parser import Op, REGISTRY, ParserRegistry, VerdictBatcher
 
 # Kafka error code injected on deny (reference: pkg/kafka/error-codes).
 TOPIC_AUTHORIZATION_FAILED = 29
@@ -159,10 +159,16 @@ class SocketProxy:
     """Owns the event loop + one TCP listener per active redirect."""
 
     def __init__(self, access_log=None, registry: ParserRegistry = REGISTRY,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", http_batch_window: float = 0.0):
         self.host = host
         self.registry = registry
         self.access_log = access_log
+        # live-proxy batch path: with a window > 0, concurrent HTTP
+        # frames are micro-batched through the redirect's policy
+        # engine (parser.VerdictBatcher) instead of one scalar
+        # check_one per frame; 0 keeps the latency-first scalar path
+        self.http_batch_window = http_batch_window
+        self._http_batchers: Dict[int, Tuple[object, VerdictBatcher]] = {}
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="socket-proxy")
@@ -464,9 +470,24 @@ class SocketProxy:
 
     # ------------------------------------------------------------- http
 
+    def _http_batcher(self, engine) -> VerdictBatcher:
+        """Per-engine VerdictBatcher (created lazily on the loop
+        thread; the engine ref is kept so id() can't be recycled)."""
+        ent = self._http_batchers.get(id(engine))
+        if ent is None:
+            def check_batch(reqs):
+                return list(engine.check(reqs))
+            ent = (engine, VerdictBatcher(
+                check_batch, max_wait=self.http_batch_window))
+            self._http_batchers[id(engine)] = ent
+        return ent[1]
+
     async def _pump_http(self, client_r, client_w, up_r, up_w, ctx,
                          peer, src_id, dst_id):
         engine = ctx.http_engine_for(peer) if ctx.http_engine_for \
+            else None
+        batcher = self._http_batcher(engine) \
+            if (self.http_batch_window > 0 and engine is not None) \
             else None
 
         async def request_path():
@@ -499,8 +520,12 @@ class SocketProxy:
                 req = HTTPRequest(method=method, path=path,
                                   host=headers.get("host", ""),
                                   headers=dict(headers))
-                allowed = engine.check_one(req) if engine is not None \
-                    else True
+                if batcher is not None:
+                    allowed = await batcher.check(req)
+                elif engine is not None:
+                    allowed = engine.check_one(req)
+                else:
+                    allowed = True
                 info = {"method": method, "path": path,
                         "host": headers.get("host", "")}
                 if not allowed:

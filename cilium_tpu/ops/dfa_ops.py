@@ -1,11 +1,21 @@
 """Batched DFA evaluation: byte-stream scan over stacked transition tables.
 
-The L7 hot loop: advance [B, R] DFA states over [B, L] payload bytes with
-one gather per byte position (``lax.scan`` over the length axis). State
-is carried in/out, so long payloads stream through in chunks with the
-state vector as the carry — the blockwise/"ring" treatment of the
-sequence dimension (SURVEY.md §2.8: streaming L7 byte-stream parsing is
-this domain's long-sequence axis).
+The reference semantics: advance [B, R] DFA states over [B, L] payload
+bytes with one gather per byte position (``lax.scan`` over the length
+axis). State is carried in/out, so long payloads stream through in
+chunks with the state vector as the carry — the blockwise/"ring"
+treatment of the sequence dimension (SURVEY.md §2.8: streaming L7
+byte-stream parsing is this domain's long-sequence axis).
+
+``dfa_match``/``dfa_scan`` here are the ORACLE tier: int32 tables, one
+dependent gather per byte, simple enough to be obviously correct — the
+parity anchor for every other walker (tests pin the scalar C++ walker,
+the sharded scan, and all ``ops/dfa_engine`` strategies to it).  The
+production L7 hot loop runs on ``ops/dfa_engine.DFAEngine``, which
+quantizes the tables, collapses the byte alphabet into equivalence
+classes, and walks k bytes per dependent step; this module keeps the
+host-encode helpers (``encode_strings``, ``bucket_cols``,
+``bucket_rows``) both tiers share.
 
 Padding convention: byte -1 marks end-of-input; states freeze there.
 """

@@ -86,6 +86,59 @@ def dfa_match_parallel(table: jnp.ndarray, accept: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# k-stride compose: depth reduction without a host-precomposed table
+# ---------------------------------------------------------------------------
+
+def dfa_scan_compose(table: jnp.ndarray, states: jnp.ndarray,
+                     data: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Serial-equivalent scan in ceil(L/k) dependent steps.
+
+    The per-byte transition functions are materialized ([B, L, S]) and
+    composed in groups of ``k`` on device — k-1 parallel ``compose``
+    rounds with no sequential dependency — then the carry walks the
+    L/k group functions.  The middle ground between ``dfa_scan``
+    (depth L, no precompute) and ``dfa_parallel_scan`` (depth log L,
+    full O(L·S) scan work): used when the table is too large to stride-
+    precompose on the host (ops/dfa_engine) but payloads are long
+    enough that depth dominates.
+
+    table: [S, 256]; states: [B, R] int32; data: [B, L]. Returns final
+    states [B, R] (bit-identical to dfa_scan; padding composes as
+    identity)."""
+    b, l = data.shape
+    pad = (-l) % k
+    if pad:
+        data = jnp.concatenate(
+            [data, jnp.full((b, pad), -1, data.dtype)], axis=1)
+    f = transition_functions(table, data)          # [B, L', S]
+    f = f.reshape(b, -1, k, f.shape[-1])
+    g = f[:, :, 0]
+    for j in range(1, k):                          # apply position j after
+        g = compose(f[:, :, j], g)                 # the earlier ones
+    def step(st, gcol):                            # gcol: [B, S]
+        # cast keeps the carry dtype stable when the table is quantized
+        nxt = jnp.take_along_axis(gcol, st, axis=-1)
+        return nxt.astype(states.dtype), None
+    final, _ = lax.scan(step, states, jnp.swapaxes(g, 0, 1))
+    return final
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def dfa_match_compose(table: jnp.ndarray, accept: jnp.ndarray,
+                      starts: jnp.ndarray, data: jnp.ndarray,
+                      k: int) -> jnp.ndarray:
+    """Anchored match via the k-stride compose scan (dfa_match
+    contract, including the -2 overlong poison)."""
+    b = data.shape[0]
+    states = jnp.broadcast_to(starts[None, :],
+                              (b, starts.shape[0])).astype(jnp.int32)
+    final = dfa_scan_compose(table, states, data, k)
+    ok = accept[final]
+    overlong = jnp.any(data == -2, axis=1)
+    return ok & ~overlong[:, None]
+
+
+# ---------------------------------------------------------------------------
 # Multi-chip: sequence axis sharded over the mesh
 # ---------------------------------------------------------------------------
 

@@ -88,6 +88,119 @@ def latest_on_accel_artifact() -> "dict | None":
         return None
 
 
+# Driver contract: the FINAL stdout line must parse as one JSON object
+# and fit the driver's ~2000-byte tail capture.  Round 5 shipped
+# `parsed: null` because the embedded on-accel artifact pushed the line
+# to ~4.5KB; the fix is structural — full results go to a committed
+# BENCH_FULL_<ts>.json and the final line is a compact digest.
+MAX_FINAL_LINE = 1450
+
+
+def save_full_result(parsed: dict) -> "str | None":
+    """Persist the FULL bench result (incl. any embedded last_on_accel
+    artifact) to BENCH_FULL_<ts>.json next to the bench script (or
+    $CILIUM_TPU_BENCH_FULL_DIR); the compact final line points at it."""
+    try:
+        out_dir = os.environ.get("CILIUM_TPU_BENCH_FULL_DIR") \
+            or _artifact_dir()
+        stamp = _time.strftime("%Y%m%d_%H%M%S", _time.gmtime())
+        path = os.path.join(out_dir, f"BENCH_FULL_{stamp}.json")
+        with open(path, "w") as f:
+            json.dump({"captured_at_utc":
+                       _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      _time.gmtime()),
+                       "result": parsed}, f, indent=1)
+        return path
+    except OSError:
+        return None
+
+
+def _suite_value(entry, key):
+    # suite entries are full result dicts (current bench.py) or the
+    # older compact {value, vs_baseline} form
+    return entry.get(key) if isinstance(entry, dict) else None
+
+
+def compact_bench_line(parsed: dict, full_file: "str | None" = None,
+                       limit: int = MAX_FINAL_LINE) -> dict:
+    """The <1.5KB driver-facing digest of a full bench result.
+
+    Keeps: headline metric, backend/on_accel/device, both latency
+    gates with the b256 p99 values, one {value, vs_baseline} pair per
+    suite config (plus the engine tag that produced it), a pointer to
+    the last committed on-accel artifact, and the BENCH_FULL file
+    carrying everything else.  Fields are dropped largest-first if the
+    rendered line would still exceed ``limit``."""
+    extra = parsed.get("extra") or {}
+    out = {"metric": parsed.get("metric"), "value": parsed.get("value"),
+           "unit": parsed.get("unit"),
+           "vs_baseline": parsed.get("vs_baseline")}
+    ex = {}
+    for k in ("backend", "on_accel", "device", "engine", "smoke",
+              "latency_under_50us_p99", "latency_under_35us_p99",
+              # standalone suite-config lines keep their claim fields
+              "at_reference_capacity", "endpoints", "policy_entries",
+              "ipcache_entries", "entries_per_endpoint",
+              "policy_build_seconds", "ipcache_build_seconds",
+              "incremental_apply_us", "batch"):
+        if k in extra:
+            ex[k] = extra[k]
+    sel = extra.get("engine_selection")
+    if isinstance(sel, dict):
+        ex["eng"] = sel.get("tag") or \
+            (sel.get("combined") or {}).get("tag")
+    sb = extra.get("small_batch_p99_us") or {}
+    p99 = {}
+    for src, dst in (("host_cache_p99_us_b256", "host"),
+                     ("host_cache_pinned_p99_us_b256", "host_pinned"),
+                     ("device_rt_p99_us_b256", "device_rt")):
+        if isinstance(sb.get(src), (int, float)):
+            p99[dst] = sb[src]
+    if p99:
+        ex["p99_b256_us"] = p99
+    suite = extra.get("suite_configs")
+    if isinstance(suite, dict):
+        cs = {}
+        for name, r in suite.items():
+            if isinstance(r, dict):
+                row = {"value": _suite_value(r, "value"),
+                       "vs_baseline": _suite_value(r, "vs_baseline")}
+                rex = r.get("extra") or {}
+                sel = rex.get("engine_selection")
+                if isinstance(sel, dict):
+                    row["eng"] = sel.get("tag") or \
+                        (sel.get("combined") or {}).get("tag")
+                if "incremental_apply_us" in rex:
+                    row["apply_us"] = rex["incremental_apply_us"]
+                if rex.get("at_reference_capacity"):
+                    row["at_reference_capacity"] = True
+                cs[name] = row
+            else:
+                cs[name] = str(r)[:60]
+        ex["suite"] = cs
+    art = extra.get("last_on_accel")
+    if isinstance(art, dict):
+        res = art.get("result") or {}
+        ptr = {"file": art.get("file"),
+               "captured_at": art.get("captured_at_utc"),
+               "config1_vps": res.get("value")}
+        reruns = art.get("suite_reruns_on_accel")
+        if isinstance(reruns, dict):
+            il4 = reruns.get("identity-l4")
+            if isinstance(il4, dict):
+                ptr["identity_l4_vps"] = il4.get("value")
+        ex["last_on_accel"] = ptr
+    if full_file:
+        ex["full"] = os.path.basename(full_file)
+    out["extra"] = ex
+    # size guard: drop the biggest optional blocks until the line fits
+    for drop in ("device", "p99_b256_us", "last_on_accel", "suite"):
+        if len(json.dumps(out)) <= limit:
+            break
+        ex.pop(drop, None)
+    return out
+
+
 def _probe_accel(timeout: float) -> bool:
     """Bounded-timeout device-enumeration probe on the ambient
     (accelerator) platform.  True only if a non-CPU device answers.
@@ -171,10 +284,16 @@ def main_with_fallback(run, timeout: float | None = None,
 
     def _emit(stdout_text):
         """Print the child's output, with the newest committed
-        on-accel artifact embedded into the LAST JSON line (and a new
-        artifact persisted when this very run was on-accel).  Earlier
-        lines pass through verbatim — bench_suite emits one JSON line
-        per config."""
+        on-accel artifact embedded into the LAST JSON result (and a
+        new artifact persisted when this very run was on-accel).
+        Earlier lines pass through verbatim — bench_suite emits one
+        JSON line per config.
+
+        Driver contract (round-5 lesson): the FULL result — embedded
+        artifact included — is persisted to BENCH_FULL_<ts>.json, and
+        the final stdout line is the compact (<1.5KB) digest from
+        compact_bench_line, so the driver's ~2KB tail capture always
+        parses.  Small lines without a suite pass through unchanged."""
         lines = stdout_text.strip().splitlines()
         for prev in lines[:-1]:
             print(prev)
@@ -195,7 +314,17 @@ def main_with_fallback(run, timeout: float | None = None,
             art = latest_on_accel_artifact()
             if art is not None:
                 extra["last_on_accel"] = art
-        print(json.dumps(parsed))
+        rendered = json.dumps(parsed)
+        if "suite_configs" not in extra and \
+                len(rendered) <= MAX_FINAL_LINE:
+            print(rendered)
+            sys.stdout.flush()
+            return
+        full_path = save_full_result(parsed)
+        if full_path:
+            print(f"[bench] full result persisted to {full_path} "
+                  f"— commit it", file=sys.stderr)
+        print(json.dumps(compact_bench_line(parsed, full_path)))
         sys.stdout.flush()
 
     # The image sets JAX_PLATFORMS=axon ambiently, so an accelerator

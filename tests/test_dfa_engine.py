@@ -1,0 +1,346 @@
+"""The fused/quantized/depth-reduced DFA engines must be bit-identical
+to the ``dfa_match`` oracle — every strategy, every quantized dtype,
+every stride width, both dispatch forms (fused on-device and host
+pack -> device walk), including ragged rows, overlong (-2) poison and
+``bucket_rows`` padding slices — and must agree with the scalar
+``native.ScalarDFA`` walker on the same compiled tables.
+"""
+
+import asyncio
+import re
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cilium_tpu.compiler.regexc import (byte_equivalence_classes,
+                                        compile_regex_set)
+from cilium_tpu.ops.dfa_engine import DFAEngine, quantize_dtype
+from cilium_tpu.ops.dfa_ops import (bucket_cols, bucket_rows, dfa_match,
+                                    dfa_scan, encode_strings)
+
+PATTERNS = ["GET", "/public/.*", "/api/v[0-9]+/users/[0-9]+",
+            ".*admin.*", "POST|PUT", "a{2,4}b*", "[^/]+/[^/]+"]
+TEXTS = ["GET", "POST", "/public/index.html", "/public/",
+         "/api/v2/users/42", "/api/vX/users/1", "xadminy", "admin",
+         "aab", "aaaaab", "ab", "foo/bar", "a/b/c", "", "x" * 200,
+         "GET /", "aa", "aaaa"]
+LENGTH = 64
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return compile_regex_set(PATTERNS)
+
+
+@pytest.fixture(scope="module")
+def oracle(compiled):
+    data = encode_strings(TEXTS, LENGTH)
+    want = np.asarray(dfa_match(jnp.asarray(compiled.table),
+                                jnp.asarray(compiled.accept),
+                                jnp.asarray(compiled.starts),
+                                jnp.asarray(data)))
+    # sanity: the oracle itself matches re.fullmatch
+    for ti, t in enumerate(TEXTS):
+        for pi, p in enumerate(PATTERNS):
+            exp = len(t) <= LENGTH and re.fullmatch(p, t) is not None
+            assert bool(want[ti, pi]) == exp, (t, p)
+    return data, want
+
+
+# ------------------------------------------------------------ compiler
+
+def test_byte_equivalence_classes_reconstruct_table(compiled):
+    class_of, class_tab = byte_equivalence_classes(compiled.table)
+    assert class_of.shape == (256,)
+    assert class_tab.shape[0] == compiled.table.shape[0]
+    assert class_tab.shape[1] < 64          # policy sets compress hard
+    # class_table[s, class_of[b]] == table[s, b] for every byte
+    np.testing.assert_array_equal(class_tab[:, class_of],
+                                  compiled.table)
+
+
+def test_byte_classes_cached(compiled):
+    a = compiled.byte_classes()
+    b = compiled.byte_classes()
+    assert a is b
+
+
+# ------------------------------------------------- strategy/dtype parity
+
+@pytest.mark.parametrize("prefer", ["stride", "compose", "assoc"])
+@pytest.mark.parametrize("dtype", [np.int8, np.int16, np.int32])
+def test_engine_parity_all_strategies_and_dtypes(compiled, oracle,
+                                                 prefer, dtype):
+    data, want = oracle
+    eng = DFAEngine(compiled, max_len=LENGTH, prefer=prefer, dtype=dtype)
+    got = np.asarray(eng.match(data))
+    np.testing.assert_array_equal(got, want)
+    # split dispatch: host pack -> device walk
+    got2 = np.asarray(eng.match_encoded(eng.encode(data)))
+    np.testing.assert_array_equal(got2, want)
+
+
+@pytest.mark.parametrize("budget", [1, 200_000, 4 << 20, 64 << 20])
+def test_engine_parity_across_stride_widths(compiled, oracle, budget):
+    """stride_budget sweeps k from 1 (quantized serial) upward; every
+    resulting width must stay bit-identical."""
+    data, want = oracle
+    eng = DFAEngine(compiled, max_len=LENGTH, prefer="stride",
+                    stride_budget=budget)
+    assert eng.k >= 1
+    np.testing.assert_array_equal(np.asarray(eng.match(data)), want)
+    np.testing.assert_array_equal(
+        np.asarray(eng.match_encoded(eng.encode(data))), want)
+
+
+def test_stride_widths_actually_vary(compiled):
+    ks = {DFAEngine(compiled, max_len=LENGTH, prefer="stride",
+                    stride_budget=b).k
+          for b in (1, 200_000, 16 << 20)}
+    assert len(ks) >= 2, f"budget sweep produced a single k: {ks}"
+
+
+def test_dtype_too_narrow_rejected(compiled):
+    if compiled.num_states <= 127:
+        pytest.skip("table fits int8")
+    with pytest.raises(ValueError):
+        DFAEngine(compiled, max_len=LENGTH, dtype=np.int8)
+
+
+def test_unknown_strategy_rejected(compiled):
+    with pytest.raises(ValueError):
+        DFAEngine(compiled, max_len=LENGTH, prefer="warp")
+
+
+# --------------------------------------------- padding/poison semantics
+
+def test_overlong_poison_never_matches(compiled):
+    eng = DFAEngine(compiled, max_len=8)
+    data = encode_strings(["x" * 100, "GET"], 8)
+    assert (data[0] == -2).all()
+    got = np.asarray(eng.match(data))
+    assert not got[0].any()
+    packed = eng.encode(data)
+    assert packed.overlong[0] and not packed.overlong[1]
+    got2 = np.asarray(eng.match_encoded(packed))
+    np.testing.assert_array_equal(got, got2)
+
+
+def test_bucket_rows_padding_slices(compiled, oracle):
+    """Row padding from bucket_rows (-1 fill) must not disturb real
+    rows, and the sliced result must equal the unpadded match."""
+    data, want = oracle
+    padded = bucket_rows(bucket_cols(data), min_rows=32)
+    assert padded.shape[0] > data.shape[0]
+    for prefer in ("stride", "compose", "assoc"):
+        eng = DFAEngine(compiled, max_len=LENGTH, prefer=prefer)
+        got = np.asarray(eng.match(padded))[:data.shape[0]]
+        np.testing.assert_array_equal(got, want, err_msg=prefer)
+        got2 = np.asarray(
+            eng.match_encoded(eng.encode(padded)))[:data.shape[0]]
+        np.testing.assert_array_equal(got2, want, err_msg=prefer)
+
+
+def test_mid_row_negative_freezes_like_dfa_scan(compiled):
+    """A negative byte mid-row freezes the state for that column and
+    resumes after — the dfa_scan contract the identity class must
+    reproduce exactly."""
+    data = encode_strings(["GET", "ab"], 8)
+    data[0, 1] = -1        # G, <pad>, T...
+    table = jnp.asarray(compiled.table)
+    starts = jnp.asarray(compiled.starts)
+    b = data.shape[0]
+    states = jnp.broadcast_to(starts[None, :],
+                              (b, starts.shape[0])).astype(jnp.int32)
+    ref = np.asarray(dfa_scan(table, states, jnp.asarray(data)))
+    for prefer in ("stride", "compose", "assoc"):
+        eng = DFAEngine(compiled, max_len=8, prefer=prefer)
+        got = np.asarray(eng.scan(states, data))
+        np.testing.assert_array_equal(got, ref, err_msg=prefer)
+
+
+# --------------------------------------------------------- streaming scan
+
+@pytest.mark.parametrize("prefer", ["stride", "compose", "assoc"])
+def test_chunked_scan_carries_state(compiled, prefer):
+    data = encode_strings(TEXTS, LENGTH)
+    table = jnp.asarray(compiled.table)
+    starts = jnp.asarray(compiled.starts)
+    b = data.shape[0]
+    states = jnp.broadcast_to(starts[None, :],
+                              (b, starts.shape[0])).astype(jnp.int32)
+    ref = np.asarray(dfa_scan(table, states, jnp.asarray(data)))
+    eng = DFAEngine(compiled, max_len=LENGTH, prefer=prefer)
+    st = states
+    for c in range(0, LENGTH, 16):     # 16 not divisible by k=3: good
+        st = eng.scan(st, data[:, c:c + 16])
+    np.testing.assert_array_equal(np.asarray(st), ref)
+
+
+def test_donated_scan_matches_undonated(compiled):
+    data = encode_strings(TEXTS, LENGTH)
+    starts = jnp.asarray(compiled.starts)
+    b = data.shape[0]
+    states = jnp.broadcast_to(starts[None, :],
+                              (b, starts.shape[0])).astype(jnp.int32)
+    eng = DFAEngine(compiled, max_len=LENGTH, prefer="stride")
+    plain = eng.scan(states, data)
+    donated = eng.scan(jnp.array(states), data, donate=True)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(donated))
+
+
+# ------------------------------------------------- scalar walker parity
+
+def test_every_strategy_agrees_with_native_scalar(compiled):
+    pytest.importorskip("cilium_tpu.native")
+    from cilium_tpu.native import ScalarDFA
+    scalar = ScalarDFA(compiled)
+    data = encode_strings(TEXTS, LENGTH)
+    for prefer in ("stride", "compose", "assoc"):
+        eng = DFAEngine(compiled, max_len=LENGTH, prefer=prefer)
+        got = np.asarray(eng.match(data))
+        for i, t in enumerate(TEXTS):
+            raw = t.encode()
+            if len(raw) > LENGTH:
+                want = np.zeros(len(compiled.starts), bool)
+            else:
+                want = scalar.match(raw)
+            assert (got[i] == want).all(), (prefer, t)
+
+
+# ------------------------------------------------------ selection report
+
+def test_selection_report_shape(compiled):
+    eng = DFAEngine(compiled, max_len=512)
+    d = eng.describe()
+    for key in ("strategy", "k", "dtype", "states", "classes",
+                "depth_at_max_len", "resident_bytes", "tag"):
+        assert key in d
+    assert d["strategy"] in ("stride", "compose", "assoc")
+    assert d["depth_at_max_len"] <= 512
+
+
+def test_selection_quantizes_on_accel_only(compiled):
+    cpu = DFAEngine(compiled, max_len=64, on_accel=False)
+    accel = DFAEngine(compiled, max_len=64, on_accel=True,
+                      prefer="stride")
+    assert cpu.describe()["dtype"] == "int32"
+    assert accel.describe()["dtype"] == \
+        np.dtype(quantize_dtype(compiled.num_states)).name
+
+
+def test_selection_long_payload_on_accel_goes_log_depth(compiled):
+    eng = DFAEngine(compiled, max_len=1024, batch_hint=256,
+                    on_accel=True)
+    assert eng.strategy == "assoc"
+    assert eng.depth() <= 10
+
+
+# -------------------------------------------------- HTTP/DNS engine tie-in
+
+def _http_engine():
+    from cilium_tpu.l7.http import HTTPPolicyEngine
+    from cilium_tpu.policy.api import PortRuleHTTP
+    rules = [PortRuleHTTP(method="GET", path="/api/.*"),
+             PortRuleHTTP(method="POST", path="/up",
+                          headers=("x-token secret",)),
+             PortRuleHTTP(method="PUT", path="/admin/.*",
+                          host="a\\.example\\.com")]
+    return HTTPPolicyEngine(rules)
+
+
+def _http_requests():
+    from cilium_tpu.l7.http import HTTPRequest
+    return [HTTPRequest("GET", "/api/1"),
+            HTTPRequest("GET", "/api/" + "x" * 600),   # overlong line
+            HTTPRequest("POST", "/up", headers={"X-Token": "secret"}),
+            HTTPRequest("POST", "/up", headers={"X-Token": "no"}),
+            HTTPRequest("PUT", "/admin/x", host="a.example.com"),
+            HTTPRequest("PUT", "/admin/x", host="b.example.com"),
+            HTTPRequest("HEAD", "/api/1")]
+
+
+def test_http_packed_path_matches_check_one():
+    eng = _http_engine()
+    reqs = _http_requests()
+    data, hdata = eng.encode_packed(reqs)
+    got = eng.check_encoded(data, hdata, len(reqs)).tolist()
+    assert got == [eng.check_one(r) for r in reqs]
+    rep = eng.engine_report()
+    assert "combined" in rep and "headers" in rep
+    assert rep["combined"]["strategy"] in ("stride", "compose", "assoc")
+
+
+def test_http_check_pipelined_matches_check():
+    eng = _http_engine()
+    reqs = _http_requests()
+    batches = [reqs[:3], reqs[3:], reqs]
+    outs = eng.check_pipelined(batches)
+    assert len(outs) == 3
+    for b, got in zip(batches, outs):
+        np.testing.assert_array_equal(got, eng.check(b))
+
+
+def test_http_check_pipelined_allow_all():
+    from cilium_tpu.l7.http import HTTPPolicyEngine
+    eng = HTTPPolicyEngine([])
+    outs = eng.check_pipelined([_http_requests()[:2]])
+    assert outs[0].tolist() == [True, True]
+    assert eng.engine_report() is None
+
+
+def test_dns_pipelined_matches_allowed():
+    from cilium_tpu.l7.dns import DNSPolicyEngine
+    from cilium_tpu.policy.api import FQDNSelector
+    eng = DNSPolicyEngine([FQDNSelector(match_pattern="*.example.com"),
+                           FQDNSelector(match_name="db.internal")])
+    batches = [["a.example.com", "evil.com"],
+               ["db.internal", "x" * 300 + ".example.com"]]
+    outs = eng.allowed_pipelined(batches)
+    for b, got in zip(batches, outs):
+        np.testing.assert_array_equal(got, eng.allowed(b))
+    assert eng.engine_report()["strategy"] in ("stride", "compose",
+                                               "assoc")
+    empty = DNSPolicyEngine([])
+    assert empty.allowed_pipelined([["a.com"]])[0].tolist() == [False]
+
+
+# ------------------------------------------------------- verdict batcher
+
+def test_verdict_batcher_batches_and_preserves_order():
+    from cilium_tpu.l7.parser import VerdictBatcher
+    calls = []
+
+    def check_batch(items):
+        calls.append(list(items))
+        return [i % 2 == 0 for i in items]
+
+    async def run():
+        vb = VerdictBatcher(check_batch, max_wait=0.005)
+        results = await asyncio.gather(*[vb.check(i) for i in range(20)])
+        return vb, results
+
+    vb, results = asyncio.run(run())
+    assert results == [i % 2 == 0 for i in range(20)]
+    # concurrency actually batched: far fewer dispatches than frames
+    assert vb.batches < 20
+    assert vb.checked == 20
+    assert vb.stats()["max_batch"] > 1
+
+
+def test_verdict_batcher_fails_closed():
+    from cilium_tpu.l7.parser import VerdictBatcher
+
+    def boom(items):
+        raise RuntimeError("engine down")
+
+    async def run():
+        vb = VerdictBatcher(boom, max_wait=0.001)
+        res = await asyncio.gather(vb.check("a"), vb.check("b"))
+        return vb, res
+
+    vb, res = asyncio.run(run())
+    assert res == [False, False]
+    assert vb.errors >= 1

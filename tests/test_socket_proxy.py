@@ -243,6 +243,54 @@ def test_http_allow_deny_through_proxy(proxy):
         upstream.shutdown()
 
 
+def test_http_batched_verdicts_through_proxy():
+    """The live-proxy batch path: with http_batch_window set,
+    concurrent requests from many connections are micro-batched
+    through the engine (parser.VerdictBatcher) and must produce the
+    same allow/deny verdicts as the scalar path."""
+    ok_response = (b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nhi")
+    upstream = _Upstream(lambda data: ok_response)
+    engine = HTTPPolicyEngine([PortRuleHTTP(method="GET",
+                                            path="/public/.*")])
+    log = AccessLog()
+    sp = SocketProxy(access_log=log, http_batch_window=0.002)
+    try:
+        ctx = ListenerContext(
+            redirect_id="3b:ingress:TCP:80", parser_type="http",
+            orig_dst=lambda peer: ("127.0.0.1", upstream.port),
+            http_engine_for=lambda peer: engine)
+        port = sp.start_listener(0, ctx)
+        results = {}
+
+        def one(i):
+            allowed = i % 2 == 0
+            path = f"/public/{i}" if allowed else f"/admin/{i}"
+            c = _connect(port)
+            try:
+                c.sendall(f"GET {path} HTTP/1.1\r\nHost: s\r\n"
+                          f"content-length: 0\r\n\r\n".encode())
+                resp = _recv_until(c, b"hi" if allowed else b"denied")
+                results[i] = b"200 OK" in resp if allowed \
+                    else b"403" in resp
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == 12 and all(results.values()), results
+        # the batcher actually saw traffic (and ideally coalesced some)
+        _eng, batcher = sp._http_batchers[id(engine)]
+        assert batcher.checked == 12
+        assert batcher.errors == 0
+    finally:
+        sp.shutdown()
+        upstream.shutdown()
+
+
 # ------------------------------------------------ full verdict -> socket
 
 def test_packet_verdict_to_socket_e2e(proxy):
