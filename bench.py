@@ -92,7 +92,8 @@ def _smoke_result():
     for name, v in (("identity-l4", 124_000_000), ("http-regex",
                     9_500_000), ("kafka-acl", 2_100_000),
                     ("fqdn", 15_600_000), ("capacity", 14_000_000),
-                    ("incremental", 363)):
+                    ("incremental", 363),
+                    ("flows-overhead", 1_200_000)):
         suite[name] = {"metric": name, "value": v, "unit": "x/s",
                        "vs_baseline": round(v / 1e7, 3),
                        "extra": {"batch": 8192, "smoke": True,
@@ -321,7 +322,7 @@ def run_bench():
     try:
         import bench_suite
         for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
-                     "capacity", "incremental"):
+                     "capacity", "incremental", "flows-overhead"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"
                 continue

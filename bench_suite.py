@@ -407,6 +407,107 @@ def bench_incremental(on_accel: bool):
              " (10M-entry bucket table full build)"})
 
 
+def bench_flows_overhead(on_accel: bool):
+    """Hubble cost proof: v4 full-pipeline verdict throughput with the
+    on-device flow aggregation fused in vs disabled.  The measured
+    step is the REAL path both ways — Datapath.process over the
+    config-1 policy (prefilter -> LB -> CT -> ipcache -> verdict),
+    with the flow-table scatter tail the only difference.  Acceptance
+    bar: <=10% verdict-throughput cost with aggregation on."""
+    from bench import build_config1
+    from cilium_tpu.datapath.engine import Datapath, make_full_batch
+
+    # production-representative policy scale: 1000 CIDR+port rules
+    # (BASELINE config-2-order probe chains + a 1000-entry ipcache),
+    # not the 100-rule smoke config — the overhead claim is about the
+    # north-star deployment, and a toy verdict path would overstate
+    # the relative cost of the flow stage
+    states, prefixes = build_config1(n_rules=1000, n_endpoints=64)
+    batch = (1 << 20) if on_accel else (1 << 16)
+    rng = np.random.default_rng(11)
+    n_endpoints = len(states)
+
+    flow_slots = 1 << 15
+
+    def make_dp(with_flows: bool) -> Datapath:
+        dp = Datapath(ct_slots=1 << 16)
+        if with_flows:
+            dp.enable_flow_aggregation(slots=flow_slots)
+        dp.load_policy(states, revision=1, ipcache_prefixes=prefixes)
+        for slot in range(n_endpoints):
+            dp.set_endpoint_identity(slot, 1000 + slot)
+        return dp
+
+    # steady-state traffic: a fixed pool of active 5-tuple flows
+    # (sampled with repetition), like a live node's CT-established
+    # working set — identical batches feed both runs
+    n_active_flows = 8192
+    pool = {
+        "endpoint": rng.integers(0, n_endpoints, n_active_flows),
+        "saddr": rng.integers(0, 1 << 32, n_active_flows,
+                              dtype=np.uint32),
+        "daddr": rng.integers(0, 1 << 32, n_active_flows,
+                              dtype=np.uint32),
+        "sport": rng.integers(1024, 65535, n_active_flows),
+        "dport": rng.integers(1, 65536, n_active_flows),
+    }
+    sel = rng.integers(0, n_active_flows, batch)
+    pkt = make_full_batch(
+        endpoint=pool["endpoint"][sel], saddr=pool["saddr"][sel],
+        daddr=pool["daddr"][sel], sport=pool["sport"][sel],
+        dport=pool["dport"][sel], length=np.full(batch, 256))
+
+    # interleaved A/B rounds with a min-of-rounds estimate: host load
+    # spikes between two long back-to-back measurements would
+    # otherwise dominate the single-digit-percent effect under test
+    # (external interference only ever ADDS time, so min is the
+    # unbiased estimator of the true step cost)
+    datapaths = {}
+    clocks = {}
+    for label, with_flows in (("disabled", False), ("enabled", True)):
+        dp = make_dp(with_flows)
+        clocks[label] = 1000
+        # settle CT entries + the full flow-claim onboarding ramp
+        # (8192 flows / 1024-claim budget, claiming every 4th batch)
+        settle = 40 if with_flows else 8
+        for _ in range(settle):
+            clocks[label] += 1
+            dp.process(pkt, now=clocks[label])
+        datapaths[label] = dp
+
+    # 8 iters per round = exactly 2 claiming batches per round at the
+    # default claim-every-4 stripe, so every round measures the same
+    # amortized mix regardless of tick phase
+    iters = 8
+    rounds = 5
+    times = {"disabled": [], "enabled": []}
+    for _ in range(rounds):
+        for label, dp in datapaths.items():
+            def step():
+                clocks[label] += 1
+                v, _e, _i, _n = dp.process(pkt, now=clocks[label])
+                v.block_until_ready()
+            total, _p99 = _bench(step, iters, warmup=1)
+            times[label].append(total / iters)
+
+    base_s = float(np.min(times["disabled"]))
+    flow_s = float(np.min(times["enabled"]))
+    base = batch / base_s
+    flows = batch / flow_s
+    overhead_pct = round((flow_s - base_s) / base_s * 100, 2)
+    return _result(
+        "flows_overhead_verdicts_per_sec", flows, "verdicts/s",
+        10_000_000.0,
+        {"batch": batch, "rounds": rounds,
+         "baseline_vps": round(base),
+         "aggregation_vps": round(flows),
+         "overhead_pct": overhead_pct,
+         "overhead_under_10pct": overhead_pct <= 10.0,
+         "flow_table": datapaths["enabled"].flow_stats(),
+         "round_ms": {k: [round(t * 1e3, 1) for t in v]
+                      for k, v in times.items()}})
+
+
 CONFIGS = {
     "identity-l4": bench_identity_l4,
     "http-regex": bench_http_regex,
@@ -414,6 +515,7 @@ CONFIGS = {
     "fqdn": bench_fqdn,
     "capacity": bench_capacity,
     "incremental": bench_incremental,
+    "flows-overhead": bench_flows_overhead,
 }
 
 

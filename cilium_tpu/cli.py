@@ -336,28 +336,82 @@ def cmd_monitor(c: Client, args) -> int:
                                 drops_only=args.drops):
             print(e["message"], flush=True)
         return 0
-    # events in one batch share a timestamp, so dedupe on the full
-    # event tuple (bounded), not the timestamp alone
-    seen = set()
+    # cursor-based polling: the ring hands out monotonic sequence
+    # numbers, so the follower resumes from ?since=<seq> — no dedupe
+    # set, no silent gap when >n events land between polls (the next
+    # poll picks up exactly where the cursor left off)
+    cursor = 0
     kind_q = f"&kind={args.type}" if args.type else ""
     try:
         while True:
             events = c.get(
-                f"/monitor?n=200&drops="
+                f"/monitor?n=200&since={cursor}&drops="
                 f"{'true' if args.drops else 'false'}{kind_q}")
             for e in events:
-                key = (e["timestamp"], e["code"], e["endpoint"],
-                       e["identity"], e["dport"], e["proto"],
-                       e["length"], e.get("kind", ""),
-                       e.get("note", ""))
-                if key not in seen:
-                    seen.add(key)
-                    print(e["message"])
-            if len(seen) > 100_000:
-                seen = set(sorted(seen)[-50_000:])
+                cursor = max(cursor, e.get("seq", 0))
+                print(e["message"])
             if not args.follow:
                 return 0
-            time.sleep(args.interval)
+            time.sleep(args.interval if not events else 0)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_hubble(c: Client, args) -> int:
+    """``cilium hubble observe`` / ``hubble stats`` — the flow
+    observability surface (hubble CLI analog) over /flows."""
+    from urllib.parse import urlencode
+    if args.hubble_cmd == "stats":
+        path = "/flows/stats"
+        if getattr(args, "aggregated", False):
+            path += "?aggregated=true"
+        _print_json(c.get(path))
+        return 0
+
+    params = []
+    for key in ("verdict", "drop_reason", "proto", "l7_protocol",
+                "l7_method", "l7_path", "node"):
+        v = getattr(args, key, None)
+        if v:
+            params.append((key, v))
+    for key in ("identity", "src_identity", "dst_identity", "endpoint",
+                "dport", "l7_status"):
+        v = getattr(args, key, None)
+        if v is not None:
+            params.append((key, str(v)))
+    if args.federated:
+        params.append(("federated", "true"))
+    cursor = args.since
+
+    def fetch():
+        qs = list(params) + [("since", str(cursor)), ("n", str(args.n))]
+        return c.get("/flows?" + urlencode(qs))
+
+    try:
+        while True:
+            out = fetch()
+            flows = out.get("flows", [])
+            for f in flows:
+                cursor = max(cursor, f.get("seq", 0))
+            if args.json:
+                for f in flows:
+                    print(json.dumps(f, sort_keys=True))
+            else:
+                from .hubble.flow import flow_from_dict
+                for f in flows:
+                    ts = time.strftime(
+                        "%H:%M:%S", time.localtime(f.get("timestamp", 0)))
+                    node = f.get("node", "")
+                    print(f"{ts} [{node}] "
+                          f"{flow_from_dict(f).describe()}")
+            if args.federated and out.get("partial"):
+                degraded = [n["name"] for n in out.get("nodes", [])
+                            if n["status"] != "ok"]
+                print(f"(partial result: {', '.join(degraded)} "
+                      "unavailable)", file=sys.stderr)
+            if not args.follow:
+                return 0
+            time.sleep(args.interval if not flows else 0)
     except KeyboardInterrupt:
         return 0
 
@@ -673,6 +727,45 @@ def build_parser() -> argparse.ArgumentParser:
                      help="with --socket: replay the last N ring "
                           "samples before following")
 
+    hb = sub.add_parser("hubble",
+                        help="flow observability (hubble CLI analog)")
+    hb_sub = hb.add_subparsers(dest="hubble_cmd", required=True)
+    ob = hb_sub.add_parser("observe", help="query/follow flow records")
+    ob.add_argument("--verdict", default="",
+                    help="FORWARDED | DROPPED | REDIRECTED")
+    ob.add_argument("--drop-reason", dest="drop_reason", default="",
+                    help="drop reason name or code")
+    ob.add_argument("--identity", type=int, default=None,
+                    help="match src OR dst identity")
+    ob.add_argument("--src-identity", dest="src_identity", type=int,
+                    default=None)
+    ob.add_argument("--dst-identity", dest="dst_identity", type=int,
+                    default=None)
+    ob.add_argument("--endpoint", type=int, default=None)
+    ob.add_argument("--dport", type=int, default=None)
+    ob.add_argument("--proto", default="", help="tcp|udp|icmp|number")
+    ob.add_argument("--l7-protocol", dest="l7_protocol", default="",
+                    help="http|dns|kafka|parser name")
+    ob.add_argument("--l7-method", dest="l7_method", default="")
+    ob.add_argument("--l7-path", dest="l7_path", default="",
+                    help="path prefix")
+    ob.add_argument("--l7-status", dest="l7_status", type=int,
+                    default=None, help="HTTP status / DNS rcode")
+    ob.add_argument("--node", default="")
+    ob.add_argument("--since", type=int, default=0,
+                    help="resume from this sequence cursor")
+    ob.add_argument("-n", type=int, default=100)
+    ob.add_argument("-f", "--follow", action="store_true")
+    ob.add_argument("--interval", type=float, default=1.0)
+    ob.add_argument("--federated", action="store_true",
+                    help="fan out to every relay peer "
+                         "(partial results flagged per node)")
+    ob.add_argument("--json", action="store_true")
+    hs = hb_sub.add_parser("stats",
+                           help="observer/aggregation/relay health")
+    hs.add_argument("--aggregated", action="store_true",
+                    help="include the on-device per-flow counters")
+
     cfgp = sub.add_parser("config", help="daemon options")
     cfgp.add_argument("options", nargs="*", help="Option=value")
 
@@ -753,6 +846,7 @@ COMMANDS = {
     "status": cmd_status, "policy": cmd_policy, "endpoint": cmd_endpoint,
     "identity": cmd_identity, "service": cmd_service,
     "prefilter": cmd_prefilter, "monitor": cmd_monitor,
+    "hubble": cmd_hubble,
     "config": cmd_config, "metrics": cmd_metrics,
     "bugtool": cmd_bugtool, "cni": cmd_cni,
     "docker-plugin": cmd_docker_plugin,

@@ -162,6 +162,17 @@ class ClusterMesh:
         with self._mu:
             return self._clusters.get(name)
 
+    def peer_nodes(self) -> List[Node]:
+        """Every node known through the mesh (the relay's federation
+        source alongside the local cluster's registry): remote-cluster
+        nodes that advertise a Hubble address become relay peers."""
+        with self._mu:
+            clusters = list(self._clusters.values())
+        out: List[Node] = []
+        for c in clusters:
+            out.extend(c.nodes())
+        return out
+
     def status(self) -> List[Dict]:
         with self._mu:
             return [c.status() for c in self._clusters.values()]

@@ -112,6 +112,37 @@ class Daemon:
         self.proxy.access_log.subscribers.append(self.monitor.notify_l7)
         self.monitor.notify_agent("agent-start", node_name)
 
+        # Hubble flow observability (hubble/): the observer rings flow
+        # records from the sampled datapath events + the structured L7
+        # access log; the device aggregation table fuses into the
+        # datapath steps; the relay federates /flows across peers
+        # discovered through the node registry + clustermesh
+        if getattr(self.config, "enable_hubble", True):
+            from ..hubble import FlowFilter, FlowObserver, HubbleRelay
+            if self.config.hubble_flow_slots > 0:
+                self.datapath.enable_flow_aggregation(
+                    slots=self.config.hubble_flow_slots,
+                    max_probe=self.config.hubble_flow_probe)
+            self.hubble = FlowObserver(
+                node=node_name,
+                capacity=self.config.hubble_ring_capacity,
+                datapath=self.datapath)
+            self.hubble.attach_monitor(self.monitor)
+            self.hubble.attach_access_log(self.proxy.access_log)
+
+            def _local_fetch(query, since, limit):
+                return {"flows": self.hubble.get_flows(
+                    FlowFilter.from_query(query), since=since,
+                    limit=limit)}
+
+            self.hubble_relay = HubbleRelay(
+                local_name=node_name, local_fetch=_local_fetch,
+                node_source=self._hubble_peer_urls,
+                deadline_s=self.config.hubble_relay_deadline_s)
+        else:
+            self.hubble = None
+            self.hubble_relay = None
+
         # the node manager must exist before the registry: registry
         # construction synchronously replays pre-existing nodes into
         # _on_node_update, which programs it
@@ -208,17 +239,42 @@ class Daemon:
     def _on_node_delete(self, full_name: str) -> None:
         self.node_manager.node_deleted(full_name)
 
-    def register_node(self, ipv4: str, pod_cidr: str) -> Node:
-        """Publish this node (pkg/node/store.go:60)."""
+    def register_node(self, ipv4: str, pod_cidr: str,
+                      hubble_address: str = "") -> Node:
+        """Publish this node (pkg/node/store.go:60).  A non-empty
+        ``hubble_address`` advertises this agent's /flows observer so
+        peers' relays federate through it."""
         from ..node.node import NodeAddress
         node = Node(name=self.node_name,
                     cluster=self.config.cluster_name,
                     cluster_id=self.config.cluster_id,
                     addresses=[NodeAddress(type="InternalIP", ip=ipv4)],
-                    ipv4_alloc_cidr=pod_cidr)
+                    ipv4_alloc_cidr=pod_cidr,
+                    hubble_address=hubble_address or None)
+        if hubble_address and self.hubble_relay is not None:
+            # the registry will announce this node under its full
+            # name; the relay must not treat that as a remote peer
+            self.hubble_relay.local_names.add(node.full_name)
         if self.node_registry is not None:
             self.node_registry.register_local(node)
         return node
+
+    def _hubble_peer_urls(self) -> Dict[str, str]:
+        """Relay peer discovery: every node known through the local
+        registry or the clustermesh that advertises a Hubble address
+        (hubble-relay's peer service, fed from the node store)."""
+        out: Dict[str, str] = {}
+        registry = getattr(self, "node_registry", None)
+        if registry is not None:
+            for node in registry.nodes():
+                if node.hubble_address:
+                    out[node.full_name] = node.hubble_address
+        mesh = getattr(self, "clustermesh", None)
+        if mesh is not None:
+            for node in mesh.peer_nodes():
+                if node.hubble_address:
+                    out[node.full_name] = node.hubble_address
+        return out
 
     # ----------------------------------------------------------- policy
 
@@ -787,7 +843,8 @@ class Daemon:
                 self.trigger_policy_updates("fqdn-update")
 
         self.dns_poller = DNSPoller(self.dns_cache, lookup=lookup,
-                                    on_change=on_change, interval=interval)
+                                    on_change=on_change, interval=interval,
+                                    access_log=self.proxy.access_log)
         with self._lock:
             for r in self._fqdn_rules:
                 self.dns_poller.register_rule(r)
@@ -823,6 +880,9 @@ class Daemon:
             "transports": transport_resilience.status_summary(),
             "datapath": {"revision": self.datapath.revision,
                          "conntrack-slots": self.datapath.ct.slots},
+            # flow observability health (hubble observer + relay)
+            "hubble": self.hubble.stats()
+            if self.hubble is not None else None,
             # runtime capability probes (bpf/run_probes.sh analog)
             "features": self._features(),
         }
@@ -948,6 +1008,8 @@ class Daemon:
         return self._xds_server
 
     def shutdown(self) -> None:
+        if getattr(self, "hubble", None) is not None:
+            self.hubble.close()
         if getattr(self, "_monitor_server", None) is not None:
             self._monitor_server.shutdown()
         if getattr(self, "_xds_server", None) is not None:

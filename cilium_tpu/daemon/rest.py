@@ -125,6 +125,15 @@ class _Handler(BaseHTTPRequestHandler):
                              "v6-allocated":
                              len(d.ipam6) if d.ipam6 is not None
                              else 0},
+                    # flow observability snapshot: recent flows, the
+                    # on-device aggregation table, relay peer health
+                    "hubble": None if d.hubble is None else {
+                        "flows": d.hubble.get_flows(limit=200),
+                        "aggregation": d.datapath.flow_stats(),
+                        "aggregated-flows":
+                        d.datapath.flow_snapshot(512),
+                        "relay": d.hubble_relay.node_health()
+                        if d.hubble_relay is not None else None},
                 })
             m = re.fullmatch(r"/kvstore/(.+)", path)
             if m:
@@ -351,11 +360,43 @@ class _Handler(BaseHTTPRequestHandler):
                 kind = qs.get("kind", [None])[0]
                 if kind == "datapath":
                     kind = ""
-                events = d.monitor.tail(n, drops_only=drops, kind=kind)
+                # resume cursor: only events with seq > since (the
+                # polling CLI follows without a dedupe set)
+                since = int(qs.get("since", ["0"])[0])
+                events = d.monitor.tail(n, drops_only=drops, kind=kind,
+                                        since=since)
                 return self._send(200, [_monitor_event_dict(e)
                                         for e in events])
             if path == "/monitor/stats" and method == "GET":
                 return self._send(200, d.monitor.stats())
+            if path == "/flows" and method == "GET":
+                # Hubble observer surface (observer GetFlows analog):
+                # filter grammar in the query string, cursor paging
+                # via since=<seq>, federation via federated=true
+                from ..hubble.filter import FlowFilter
+                flt = FlowFilter.from_query(qs)
+                n = int(qs.get("n", ["100"])[0])
+                if qs.get("federated", ["false"])[0] in ("1", "true"):
+                    if d.hubble_relay is None:
+                        return self._error(503, "no relay configured")
+                    return self._send(200, d.hubble_relay.get_flows(
+                        flt, limit=n))
+                if d.hubble is None:
+                    return self._error(503, "hubble disabled")
+                return self._send(200, {
+                    "flows": d.hubble.get_flows(flt, limit=n),
+                    "seq": d.hubble.store.last_seq,
+                    "node": d.hubble.node})
+            if path == "/flows/stats" and method == "GET":
+                if d.hubble is None:
+                    return self._error(503, "hubble disabled")
+                out = d.hubble.stats()
+                if d.hubble_relay is not None:
+                    out["relay"] = d.hubble_relay.node_health()
+                agg = qs.get("aggregated", ["false"])[0]
+                if agg in ("1", "true"):
+                    out["flows"] = d.hubble.aggregate_snapshot()
+                return self._send(200, out)
             if path == "/node" and method == "GET":
                 # cilium node list (pkg/node)
                 return self._send(200, [

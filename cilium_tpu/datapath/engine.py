@@ -87,6 +87,59 @@ class Datapath:
         # (endpoint/tables.py); row syncs swap tensors without re-jit
         self._table_mgr = None
         self._mgr_geometry = None  # (capacity, slots, max_probe, gen)
+        # Hubble on-device flow aggregation (hubble/aggregation.py):
+        # when enabled, both family steps scatter per-flow counters
+        # into this device table inside the same compiled program
+        self.flows = None
+
+    def enable_flow_aggregation(self, slots: int = 1 << 12,
+                                max_probe: int = 8,
+                                claim_every: int = 4) -> None:
+        """Turn on Hubble's device-resident flow table: the jitted v4
+        and v6 steps gain a fused scatter-add tail keyed by (src
+        identity, dst identity, dport, proto, event).  Both families
+        share one table — flow keys are identity-based, like the
+        policy tables.
+
+        ``claim_every`` is the flow-birth admission stripe: only every
+        N-th batch runs the claim machinery (the static
+        claim_budget=0 variant of the step handles the rest), so the
+        steady-state hot path pays for the reduction alone while new
+        flows are admitted within N batches — the same
+        bounded-admission idea as the per-batch claim budget."""
+        from ..hubble.aggregation import FlowTable
+        with self._lock:
+            if self.flows is not None and self.flows.slots == slots:
+                return
+            self.flows = FlowTable(slots=slots, max_probe=max_probe)
+            self._flow_claim_every = max(1, claim_every)
+            self._flow_tick = 0
+            if self._step is not None:
+                self._rebuild()
+
+    def disable_flow_aggregation(self) -> None:
+        with self._lock:
+            if self.flows is None:
+                return
+            self.flows = None
+            if self._step is not None:
+                self._rebuild()
+
+    def flow_snapshot(self, max_entries: int = 4096):
+        """Decoded per-flow aggregates ([] when disabled).  Snapshot
+        refs are taken under the lock; decode happens lock-free on the
+        immutable arrays (map_dump convention)."""
+        with self._lock:
+            flows = self.flows
+        return [] if flows is None else flows.snapshot(max_entries)
+
+    def flow_stats(self):
+        with self._lock:
+            flows = self.flows
+            claim_every = getattr(self, "_flow_claim_every", 1)
+        if flows is None:
+            return None
+        return {**flows.stats(), "claim-every": claim_every}
 
     def set_router_ip6(self, ip: str) -> None:
         """Program the v6 router address the ICMPv6/NDP responder
@@ -249,9 +302,13 @@ class Datapath:
                 grown[:self._ep_identity.shape[0]] = self._ep_identity
                 self._ep_identity = grown
             self._ep_identity[slot] = identity
+            ep_ident = jnp.asarray(self._ep_identity)
             if self._tables is not None:
                 self._tables = self._tables._replace(
-                    ep_identity=jnp.asarray(self._ep_identity))
+                    ep_identity=ep_ident)
+            if self._tables6 is not None:
+                self._tables6 = self._tables6._replace(
+                    ep_identity=ep_ident)
 
     def reload_services(self) -> None:
         with self._lock:
@@ -302,24 +359,43 @@ class Datapath:
                 tun_key_a=jnp.asarray(tun.key_a),
                 tun_key_b=jnp.asarray(tun.key_b),
                 tun_value=jnp.asarray(tun.value),
-                tun_plens=jnp.asarray(tun.prefix_lens),
-                ep_identity=jnp.asarray(self._ep_identity))
+                tun_plens=jnp.asarray(tun.prefix_lens))
+        # the slot->identity table serves both the encap stage and the
+        # flow-aggregation key, so it is always device-resident
+        ep_ident = jnp.asarray(self._ep_identity)
         self._tables = FullTables(
             datapath=dp, lb=self.lb.compiled.tables,
             pf_masks=jnp.asarray(pf.masks), pf_key_a=jnp.asarray(pf.key_a),
             pf_key_b=jnp.asarray(pf.key_b), pf_value=jnp.asarray(pf.value),
-            pf_plens=jnp.asarray(pf.prefix_lens), **tun_kwargs)
+            pf_plens=jnp.asarray(pf.prefix_lens),
+            ep_identity=ep_ident, **tun_kwargs)
         if self.counters is None or self.counters.packets.shape[0] != n:
             self.counters = Counters(packets=jnp.zeros(n, jnp.uint32),
                                      bytes=jnp.zeros(n, jnp.uint32))
-        self._step = jax.jit(functools.partial(
-            full_datapath_step,
+        flow_kwargs = {}
+        if self.flows is not None:
+            flow_kwargs = dict(flow_slots=self.flows.slots,
+                               flow_probe=self.flows.max_probe)
+            # the flows arg is deliberately NOT donated: donation of
+            # the scatter-updated flow buffers measurably degrades the
+            # whole fused program on the CPU backend (XLA copies the
+            # donated buffers out of line), and the table is ~1MB —
+            # double-buffering it costs nothing
+        v4_static = dict(
             policy_probe=policy_probe,
             lpm_probe=max(1, self.compiled_ipcache.max_probe),
             pf_probe=max(1, pf.max_probe),
             lb_probe=self.lb.compiled.max_probe,
             ct_slots=self.ct.slots, ct_probe=self.ct.max_probe,
-            tun_probe=tun_probe),
+            tun_probe=tun_probe)
+        self._step = jax.jit(functools.partial(
+            full_datapath_step, **v4_static, **flow_kwargs),
+            donate_argnums=(1, 2))
+        # the claim-free (admission-striped) variant; compiled lazily
+        # on first use like every jitted step
+        self._step_nc = None if self.flows is None else jax.jit(
+            functools.partial(full_datapath_step, **v4_static,
+                              **flow_kwargs, flow_claim_budget=0),
             donate_argnums=(1, 2))
 
         # v6 twin: shares the (family-agnostic) policy tensors, runs
@@ -334,17 +410,32 @@ class Datapath:
             key_id=dp.key_id, key_meta=dp.key_meta, value=dp.value,
             ipcache6=lpm6_tables(ipc6), pf6=lpm6_tables(pf6),
             lb6=lb6.tables if lb6 is not None else None,
-            router_ip6=self._router_ip6)
-        self._step6 = jax.jit(functools.partial(
-            full_datapath_step6,
+            router_ip6=self._router_ip6, ep_identity=ep_ident)
+        v6_static = dict(
             policy_probe=policy_probe,
             lpm6_probe=max(1, ipc6.max_probe),
             pf6_probe=max(1, pf6.max_probe),
             ct_slots=self.ct6.slots, ct_probe=self.ct6.max_probe,
-            lb6_probe=lb6.max_probe if lb6 is not None else 0),
+            lb6_probe=lb6.max_probe if lb6 is not None else 0)
+        self._step6 = jax.jit(functools.partial(
+            full_datapath_step6, **v6_static, **flow_kwargs),
+            donate_argnums=(1, 2))
+        self._step6_nc = None if self.flows is None else jax.jit(
+            functools.partial(full_datapath_step6, **v6_static,
+                              **flow_kwargs, flow_claim_budget=0),
             donate_argnums=(1, 2))
 
     # -- the hot path --------------------------------------------------------
+
+    def _flow_step_variant(self, step, step_nc):
+        """Claim-admission striping: every ``claim_every``-th batch
+        runs the claiming step; the rest run the statically claim-free
+        variant (callers hold the engine lock)."""
+        tick = self._flow_tick
+        self._flow_tick = tick + 1
+        if tick % self._flow_claim_every == 0:
+            return step
+        return step_nc
 
     def process(self, pkt: FullPacketBatch, now: Optional[int] = None):
         """Classify a batch. Returns (verdict, event, identity, nat) —
@@ -352,10 +443,18 @@ class Datapath:
         with self._lock:
             if self._step is None:
                 raise RuntimeError("no policy loaded")
-            (verdict, event, identity, nat,
-             self.ct.state, self.counters) = self._step(
-                self._tables, self.ct.state, self.counters, pkt,
-                jnp.int32(now if now is not None else int(time.time())))
+            ts = jnp.int32(now if now is not None else int(time.time()))
+            if self.flows is not None:
+                step = self._flow_step_variant(self._step,
+                                               self._step_nc)
+                (verdict, event, identity, nat, self.ct.state,
+                 self.counters, self.flows.state) = step(
+                    self._tables, self.ct.state, self.counters, pkt,
+                    ts, self.flows.state)
+            else:
+                (verdict, event, identity, nat,
+                 self.ct.state, self.counters) = self._step(
+                    self._tables, self.ct.state, self.counters, pkt, ts)
             return verdict, event, identity, nat
 
     def process6(self, pkt: FullPacketBatch6,
@@ -365,10 +464,19 @@ class Datapath:
         with self._lock:
             if self._step6 is None:
                 raise RuntimeError("no policy loaded")
-            (verdict, event, identity, nat,
-             self.ct6.state, self.counters) = self._step6(
-                self._tables6, self.ct6.state, self.counters, pkt,
-                jnp.int32(now if now is not None else int(time.time())))
+            ts = jnp.int32(now if now is not None else int(time.time()))
+            if self.flows is not None:
+                step = self._flow_step_variant(self._step6,
+                                               self._step6_nc)
+                (verdict, event, identity, nat, self.ct6.state,
+                 self.counters, self.flows.state) = step(
+                    self._tables6, self.ct6.state, self.counters, pkt,
+                    ts, self.flows.state)
+            else:
+                (verdict, event, identity, nat,
+                 self.ct6.state, self.counters) = self._step6(
+                    self._tables6, self.ct6.state, self.counters, pkt,
+                    ts)
             return verdict, event, identity, nat
 
     def lb6_service_list(self):
@@ -431,6 +539,8 @@ class Datapath:
             out["lb"] = {"services": len(self.lb)}
             out["lb6"] = {"services": len(self.lb6_services)}
             out["tunnel"] = {"entries": len(self.tunnel_prefixes)}
+            if self.flows is not None:
+                out["hubble-flows"] = self.flows.stats()
             pf = self.prefilter._compiled
             pf6 = self.prefilter._compiled6
             out["prefilter"] = {
@@ -458,7 +568,13 @@ class Datapath:
                         for cidr, ip in
                         sorted(self.tunnel_prefixes.items())
                         [:max_entries]}
-            if name in ("ct", "ct6"):
+            if name == "hubble-flows":
+                flows = self.flows
+                if flows is None:
+                    return []
+                # immutable device arrays: decode outside the lock,
+                # same convention as the CT dump below
+            elif name in ("ct", "ct6"):
                 st = (self.ct if name == "ct" else self.ct6).state
             elif name == "lb":
                 svcs = self.lb.services()[:max_entries]
@@ -469,6 +585,8 @@ class Datapath:
                 return {"cidrs": cidrs[:max_entries], "revision": rev}
             else:
                 raise KeyError(name)
+        if name == "hubble-flows":
+            return flows.snapshot(max_entries)
         if name in ("ct", "ct6"):
             k3 = np.asarray(st.k3)
             # exclude the sentinel slot (the last row absorbs no-op

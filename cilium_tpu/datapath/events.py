@@ -47,3 +47,9 @@ TRACE_NAMES = {
     ICMP6_NS_REPLY: "icmp6-ns-reply",
     ICMP6_ECHO_REPLY: "icmp6-echo-reply",
 }
+
+
+def event_name(code: int) -> str:
+    """Human name for any event code (drop reason or trace point)."""
+    return DROP_NAMES.get(code) or TRACE_NAMES.get(code) or \
+        f"code {code}"

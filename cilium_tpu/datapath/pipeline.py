@@ -183,11 +183,30 @@ class FullTables(NamedTuple):
     ep_identity: jnp.ndarray = None   # [E] local slot -> own identity
 
 
+def _flow_identities(ep_identity, endpoint, peer_identity, direction):
+    """(src, dst) security identities for the flow key: the endpoint's
+    own identity (SECLABEL) on its side of the flow, the resolved peer
+    identity on the other — egress flows read ep->peer, ingress flows
+    peer->ep (hubble/aggregation flow key convention)."""
+    if ep_identity is not None:
+        n_ep = ep_identity.shape[0]
+        own = ep_identity[jnp.clip(endpoint, 0, n_ep - 1)]
+    else:
+        own = jnp.zeros_like(peer_identity)
+    egress = direction == 1
+    src = jnp.where(egress, own, peer_identity)
+    dst = jnp.where(egress, peer_identity, own)
+    return src, dst
+
+
 def full_datapath_step(tables: FullTables, ct, counters: Counters,
-                       pkt: FullPacketBatch, now: jnp.ndarray, *,
+                       pkt: FullPacketBatch, now: jnp.ndarray,
+                       flows=None, *,
                        policy_probe: int, lpm_probe: int, pf_probe: int,
                        lb_probe: int, ct_slots: int, ct_probe: int,
-                       tun_probe: int = 0):
+                       tun_probe: int = 0, flow_slots: int = 0,
+                       flow_probe: int = 0,
+                       flow_claim_budget: int = 1024):
     """The batched equivalent of the reference's per-packet egress path
     (bpf_lxc.c:432 handle_ipv4_from_lxc): XDP prefilter drop, service
     DNAT (lb4_local), conntrack lookup, ipcache identity resolve, policy
@@ -321,6 +340,21 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
     nat = NATResult(daddr=daddr, dport=dport, saddr=nat_saddr,
                     sport=nat_sport, rev_nat=ct_rev_nat,
                     tunnel_ep=tun_ep_out, tunnel_id=tun_id_out)
+    if flows is not None and flow_slots > 0:
+        # 10. Hubble on-device flow aggregation: the same compiled
+        # program that produced the verdict reduces per-flow state —
+        # packet/byte counters + last-seen keyed by (src identity,
+        # dst identity, DNAT'd dport, proto, event) — so host-side
+        # observability reads compact aggregates, not packets.
+        from ..hubble.aggregation import flow_update_step
+        src_id, dst_id = _flow_identities(tables.ep_identity,
+                                          pkt.endpoint, identity,
+                                          pkt.direction)
+        flows = flow_update_step(
+            flows, src_id, dst_id, dport, pkt.proto, event,
+            pkt.length, now, slots=flow_slots, max_probe=flow_probe,
+            claim_budget=flow_claim_budget)
+        return verdict, event, identity, nat, ct, counters, flows
     return verdict, event, identity, nat, ct, counters
 
 
@@ -409,6 +443,9 @@ class FullTables6(NamedTuple):
     # — the address whose NS/echo the datapath answers itself; None
     # disables the ICMPv6 responder stage
     router_ip6: jnp.ndarray = None
+    # [E] local slot -> own security identity (shared with the v4
+    # tables; the flow-aggregation stage keys on it)
+    ep_identity: jnp.ndarray = None
 
 
 def lpm6_tables(c) -> LPM6Tables:
@@ -428,10 +465,13 @@ def fold6(words: jnp.ndarray) -> jnp.ndarray:
 
 
 def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
-                        pkt: FullPacketBatch6, now: jnp.ndarray, *,
+                        pkt: FullPacketBatch6, now: jnp.ndarray,
+                        flows=None, *,
                         policy_probe: int, lpm6_probe: int,
                         pf6_probe: int, ct_slots: int, ct_probe: int,
-                        lb6_probe: int = 0):
+                        lb6_probe: int = 0, flow_slots: int = 0,
+                        flow_probe: int = 0,
+                        flow_claim_budget: int = 1024):
     """The v6 twin of full_datapath_step (bpf_lxc.c:745 ipv6_policy):
     prefilter drop, service DNAT (lb6_local), conntrack, ipcache
     identity, policy verdict for CT_NEW flows, CT create gated on the
@@ -573,4 +613,18 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                                       jnp.int32(TRACE_TO_LXC))))))))
     nat = NAT6Result(daddr=daddr, dport=dport, saddr=nat_saddr,
                      sport=nat_sport, rev_nat=ct_rev_nat)
+    if flows is not None and flow_slots > 0:
+        # Hubble flow aggregation, v6 twin (flow keys are identity-
+        # based, so the table is family-agnostic like the policy
+        # tables; locally answered ICMPv6 still aggregates, under its
+        # reply event code).
+        from ..hubble.aggregation import flow_update_step
+        src_id, dst_id = _flow_identities(tables.ep_identity,
+                                          pkt.endpoint, identity,
+                                          pkt.direction)
+        flows = flow_update_step(
+            flows, src_id, dst_id, dport, pkt.proto, event,
+            pkt.length, now, slots=flow_slots, max_probe=flow_probe,
+            claim_budget=flow_claim_budget)
+        return verdict, event, identity, nat, ct, counters, flows
     return verdict, event, identity, nat, ct, counters

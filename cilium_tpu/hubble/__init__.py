@@ -1,0 +1,33 @@
+"""Hubble on TPU: flow observability with on-device aggregation.
+
+The subsystem spans device -> daemon -> CLI -> cluster:
+
+    aggregation — device-resident flow table updated inside the jitted
+                  v4/v6 datapath pipelines (scatter-add per-flow
+                  packet/byte counters + last-seen)
+    flow        — FlowRecord + the bounded host ring with monotonic
+                  sequence cursors
+    filter      — the observe filter grammar (identity, verdict, drop
+                  reason, port, proto, L7, since)
+    observer    — one node's queryable flow view + flow-derived metrics
+    relay       — federated get_flows fan-out with per-peer deadlines
+                  and circuit breakers (fail-open, flagged partials)
+"""
+
+from .aggregation import (FlowState, FlowTable, aggregate_oracle,
+                          flow_update_step, make_flow_state,
+                          snapshot_to_oracle_form)
+from .filter import FlowFilter, parse_drop_reason, parse_proto, parse_verdict
+from .flow import (FlowRecord, FlowStore, flow_from_access_log,
+                   flow_from_dict, flow_from_event, verdict_of_event)
+from .observer import FlowObserver
+from .relay import HubbleRelay, rest_peer
+
+__all__ = [
+    "FlowState", "FlowTable", "aggregate_oracle", "flow_update_step",
+    "make_flow_state", "snapshot_to_oracle_form",
+    "FlowFilter", "parse_drop_reason", "parse_proto", "parse_verdict",
+    "FlowRecord", "FlowStore", "flow_from_access_log", "flow_from_dict",
+    "flow_from_event", "verdict_of_event",
+    "FlowObserver", "HubbleRelay", "rest_peer",
+]

@@ -25,6 +25,12 @@ from ..policy.api import CIDRRule, FQDNSelector, Rule
 DNS_POLLER_INTERVAL = 5.0  # reference: dnspoller.go:50 (5s)
 MAX_NAME_LEN = 255
 
+# DNS response-code names (RFC 1035 RCODE; the Hubble DNS metric label)
+RCODE_NOERROR = 0
+RCODE_NXDOMAIN = 3
+RCODE_NAMES = {0: "NoError", 1: "FormErr", 2: "ServFail",
+               3: "NXDomain", 4: "NotImp", 5: "Refused"}
+
 
 def _canon(name: str) -> str:
     return name.lower().rstrip(".")
@@ -213,15 +219,35 @@ class DNSPoller:
     def __init__(self, cache: DNSCache,
                  lookup: Callable[[List[str]], Dict[str, Tuple[List[str], int]]],
                  on_change: Optional[Callable[[Set[str]], None]] = None,
-                 interval: float = DNS_POLLER_INTERVAL):
+                 interval: float = DNS_POLLER_INTERVAL,
+                 access_log=None):
         self.cache = cache
         self.lookup = lookup       # names -> {name: (ips, ttl)}
         self.on_change = on_change
         self.interval = interval
+        # DNS resolutions enter the L7 access log (and through it the
+        # Hubble flow stream + rcode metrics): one record per polled
+        # name, rcode NoError/NXDomain from the resolver's answer
+        self.access_log = access_log
         self._names: Set[str] = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _log_answers(self, results: Dict[str, Tuple[List[str], int]]
+                     ) -> None:
+        if self.access_log is None:
+            return
+        from ..proxy import AccessLogEntry  # lazy: avoids module cycle
+        for name, (ips, _ttl) in sorted(results.items()):
+            rcode = RCODE_NOERROR if ips else RCODE_NXDOMAIN
+            self.access_log.log(AccessLogEntry(
+                timestamp=time.time(), proxy_id="dns-poller",
+                l7_protocol="dns", verdict="forwarded",
+                src_identity=0, dst_identity=0,
+                info={"query": name, "rcode": rcode,
+                      "rcode-name": RCODE_NAMES[rcode],
+                      "ips": list(ips)}))
 
     def register_rule(self, rule: Rule) -> None:
         with self._lock:
@@ -238,6 +264,7 @@ class DNSPoller:
             return set()
         before = {n: tuple(self.cache.lookup(n, now)) for n in names}
         results = self.lookup(names)
+        self._log_answers(results)
         for name, (ips, ttl) in results.items():
             self.cache.update(name, ips, ttl, now)
         changed = {n for n in names

@@ -35,6 +35,19 @@ MAX_REQUEST_LINE = 512
 MAX_HEADER_BLOCK = 1024
 
 
+def parse_status_line(line: bytes) -> Optional[int]:
+    """``HTTP/1.x NNN Reason`` -> NNN, else None — the response-side
+    sample the proxy feeds the Hubble HTTP response-code metrics
+    (envoy access-log %RESPONSE_CODE% analog)."""
+    if not line.startswith(b"HTTP/"):
+        return None
+    parts = line.split(None, 2)
+    if len(parts) < 2 or not parts[1].isdigit():
+        return None
+    code = int(parts[1])
+    return code if 100 <= code <= 599 else None
+
+
 def _rule_to_combined_regex(rule: PortRuleHTTP) -> str:
     m = rule.method if rule.method else "[^\\x00]*"
     p = rule.path if rule.path else "[^\\x00]*"

@@ -583,10 +583,28 @@ class SocketProxy:
                 pass
 
         async def reply_path():
+            from .http import parse_status_line
+            head_buf = b""
             while True:
                 chunk = await up_r.read(65536)
                 if not chunk:
                     break
+                # Response-status sampling for the Hubble HTTP metrics
+                # (%RESPONSE_CODE% analog): status lines that start a
+                # chunk are parsed; mid-chunk pipelined continuations
+                # stream through unsampled — counters, not framing,
+                # ride on this
+                if head_buf or chunk.startswith(b"HTTP/"):
+                    head_buf = (head_buf + chunk)[:256]
+                    nl = head_buf.find(b"\r\n")
+                    if nl >= 0:
+                        status = parse_status_line(head_buf[:nl])
+                        if status is not None:
+                            self._log(ctx, "response", "http", dst_id,
+                                      src_id, {"status": status})
+                        head_buf = b""
+                    elif len(head_buf) >= 256:
+                        head_buf = b""
                 client_w.write(chunk)
                 await client_w.drain()
             try:

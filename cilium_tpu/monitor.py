@@ -42,6 +42,9 @@ class MonitorEvent:
     length: int
     kind: str = ""       # "" | "agent" | "l7"
     note: str = ""
+    # hub-assigned monotonic sequence number (perf-ring cursor analog):
+    # pollers resume from ?since=<seq> instead of deduping replays
+    seq: int = 0
 
     @property
     def is_drop(self) -> bool:
@@ -75,6 +78,8 @@ class MonitorHub:
         self.lost = 0  # samples not ringed (perf-ring lost-events analog)
         # AgentNotify / LogRecordNotify counters, keyed by event name
         self._notify_counts: Dict[str, int] = {}
+        # monotonic event cursor; 0 is the "from the beginning" sentinel
+        self._next_seq = 1
 
     # ------------------------------------------------------------ ingest
 
@@ -114,6 +119,12 @@ class MonitorHub:
                 self._counts[code] = self._counts.get(code, 0) + int(n)
                 self._bytes[code] = self._bytes.get(code, 0) + \
                     drop_bytes[code]
+            # stamp the monotonic cursor under the lock (the seq order
+            # IS the ring order — pollers resume from it)
+            from dataclasses import replace as _replace
+            samples = [_replace(ev, seq=self._next_seq + i)
+                       for i, ev in enumerate(samples)]
+            self._next_seq += len(samples)
             self._ring.extend(samples)
             if len(self._ring) > self.ring_capacity:
                 self._ring = self._ring[-self.ring_capacity:]
@@ -124,9 +135,12 @@ class MonitorHub:
                 fn(ev)
 
     def _push(self, ev: MonitorEvent, counter: str) -> None:
+        from dataclasses import replace as _replace
         with self._lock:
             self._notify_counts[counter] = \
                 self._notify_counts.get(counter, 0) + 1
+            ev = _replace(ev, seq=self._next_seq)
+            self._next_seq += 1
             self._ring.append(ev)
             if len(self._ring) > self.ring_capacity:
                 self._ring = self._ring[-self.ring_capacity:]
@@ -171,14 +185,29 @@ class MonitorHub:
         return unsubscribe
 
     def tail(self, n: int = 100, drops_only: bool = False,
-             kind: Optional[str] = None) -> List[MonitorEvent]:
+             kind: Optional[str] = None,
+             since: int = 0) -> List[MonitorEvent]:
+        """Matching samples.  Without ``since``: the last ``n`` (the
+        "show me recent events" view).  With ``since``: the OLDEST
+        ``n`` with seq > since — forward paging, so a follower that
+        fell behind a burst drains it page by page instead of having
+        the middle silently capped away (nothing is lost unless it
+        fell off the ring, which ``last_seq`` vs the first returned
+        seq reveals)."""
         with self._lock:
             ring = list(self._ring)
+        if since:
+            ring = [e for e in ring if e.seq > since]
         if drops_only:
             ring = [e for e in ring if e.is_drop]
         if kind is not None:
             ring = [e for e in ring if e.kind == kind]
-        return ring[-n:]
+        return ring[:n] if since else ring[-n:]
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._next_seq - 1
 
     def stats(self) -> Dict[str, Dict]:
         """metricsmap-style dump: per-code packet/byte totals, plus
@@ -214,7 +243,7 @@ class MonitorHub:
 # queue per subscriber, overflow counted and dropped.
 
 def _monitor_event_dict(ev: MonitorEvent) -> Dict:
-    return {"timestamp": ev.timestamp, "code": ev.code,
+    return {"seq": ev.seq, "timestamp": ev.timestamp, "code": ev.code,
             "endpoint": ev.endpoint, "identity": ev.identity,
             "dport": ev.dport, "proto": ev.proto, "length": ev.length,
             "kind": ev.kind, "note": ev.note,

@@ -32,6 +32,9 @@ class Node:
     addresses: List[NodeAddress] = field(default_factory=list)
     ipv4_alloc_cidr: Optional[str] = None  # pod CIDR served by this node
     ipv6_alloc_cidr: Optional[str] = None
+    # observer endpoint this node's Hubble serves /flows on (base URL);
+    # peers' relays federate through it (hubble-relay peer service)
+    hubble_address: Optional[str] = None
 
     @property
     def full_name(self) -> str:
@@ -56,7 +59,7 @@ class Node:
         return best
 
     def to_model(self) -> Dict:
-        return {
+        out = {
             "Name": self.name,
             "Cluster": self.cluster,
             "ClusterID": self.cluster_id,
@@ -65,6 +68,9 @@ class Node:
             "IPv4AllocCIDR": self.ipv4_alloc_cidr,
             "IPv6AllocCIDR": self.ipv6_alloc_cidr,
         }
+        if self.hubble_address:
+            out["HubbleAddress"] = self.hubble_address
+        return out
 
     @classmethod
     def from_model(cls, d: Dict) -> "Node":
@@ -73,4 +79,5 @@ class Node:
                    addresses=[NodeAddress(type=a["Type"], ip=a["IP"])
                               for a in d.get("IPAddresses", [])],
                    ipv4_alloc_cidr=d.get("IPv4AllocCIDR"),
-                   ipv6_alloc_cidr=d.get("IPv6AllocCIDR"))
+                   ipv6_alloc_cidr=d.get("IPv6AllocCIDR"),
+                   hubble_address=d.get("HubbleAddress"))

@@ -236,3 +236,30 @@ IDENTITY_COUNT = registry.gauge(
     "identity_count", "Number of security identities allocated")
 KVSTORE_OPERATIONS = registry.counter(
     "kvstore_operations_total", "kvstore operations by kind")
+
+# Hubble flow-observability series (pkg/hubble/metrics analog): flow
+# throughput, drops by reason x identity pair, L7 response-code
+# distributions, and relay federation health.
+HUBBLE_FLOWS_PROCESSED = registry.counter(
+    "hubble_flows_processed_total",
+    "Flow records processed by the observer")
+HUBBLE_FLOWS_LOST = registry.counter(
+    "hubble_lost_events_total",
+    "Flow events lost (ring eviction or device table exhaustion)")
+HUBBLE_DROPS = registry.counter(
+    "hubble_drop_total",
+    "Dropped-flow records by reason and identity pair")
+HUBBLE_HTTP_RESPONSES = registry.counter(
+    "hubble_http_responses_total",
+    "HTTP responses observed at the proxy, by status code and method")
+HUBBLE_DNS_RESPONSES = registry.counter(
+    "hubble_dns_responses_total",
+    "DNS responses observed, by rcode")
+HUBBLE_RELAY_PEERS = registry.gauge(
+    "hubble_relay_peers", "Registered relay peers by state")
+HUBBLE_RELAY_FAILURES = registry.counter(
+    "hubble_relay_peer_failures_total",
+    "Relay peer fetch failures by peer and kind")
+HUBBLE_RELAY_SECONDS = registry.histogram(
+    "hubble_relay_peer_seconds",
+    "Relay per-peer get_flows fan-out latency")

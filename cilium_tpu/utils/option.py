@@ -218,6 +218,15 @@ class DaemonConfig:
     # that lets a SIGKILLed agent restart with its established flows.
     ct_checkpoint_interval_s: float = 10.0
     monitor_queue_size: int = 4096
+    # Hubble flow observability (hubble/): the host flow ring, and the
+    # on-device aggregation table fused into the datapath steps
+    # (0 slots = host ring only, no device table)
+    enable_hubble: bool = True
+    hubble_ring_capacity: int = 8192
+    hubble_flow_slots: int = 1 << 12
+    hubble_flow_probe: int = 8
+    # relay fan-out deadline (a dead peer costs at most this per query)
+    hubble_relay_deadline_s: float = 2.0
     kvstore: str = "memory"
     kvstore_opts: Dict[str, str] = field(default_factory=dict)
     # runtime-mutable option map shared by new endpoints

@@ -174,6 +174,9 @@ def compact_bench_line(parsed: dict, full_file: "str | None" = None,
                     row["apply_us"] = rex["incremental_apply_us"]
                 if rex.get("at_reference_capacity"):
                     row["at_reference_capacity"] = True
+                if "overhead_pct" in rex:
+                    # flows-overhead: the <=10% aggregation-cost claim
+                    row["overhead_pct"] = rex["overhead_pct"]
                 cs[name] = row
             else:
                 cs[name] = str(r)[:60]

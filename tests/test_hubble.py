@@ -1,0 +1,599 @@
+"""Hubble flow observability: filter grammar, device-aggregation
+oracle parity, flow store cursors, relay degradation, and Prometheus
+conformance of the flow-derived metrics."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.hubble.aggregation import (FlowTable, aggregate_oracle,
+                                           flow_update_step,
+                                           make_flow_state,
+                                           snapshot_to_oracle_form)
+from cilium_tpu.hubble.filter import (FlowFilter, parse_drop_reason,
+                                      parse_proto, parse_verdict)
+from cilium_tpu.hubble.flow import (FlowRecord, FlowStore,
+                                    flow_from_access_log,
+                                    flow_from_event, verdict_of_event)
+from cilium_tpu.hubble.observer import FlowObserver
+from cilium_tpu.hubble.relay import HubbleRelay
+from cilium_tpu.monitor import MonitorEvent, MonitorHub
+
+
+def _flow(seq=1, **kw):
+    base = dict(seq=seq, timestamp=100.0, node="n1",
+                verdict="FORWARDED", src_identity=256,
+                dst_identity=512, endpoint=3, dport=80, proto=6,
+                length=100, event=0)
+    base.update(kw)
+    return FlowRecord(**base)
+
+
+# ---------------------------------------------------------------- filters
+
+class TestFilterGrammar:
+    def test_empty_filter_matches_all(self):
+        assert FlowFilter().matches(_flow())
+
+    @pytest.mark.parametrize("field,value,flow_kw", [
+        ("src_identity", 256, {}),
+        ("dst_identity", 512, {}),
+        ("endpoint", 3, {}),
+        ("dport", 80, {}),
+        ("proto", 6, {}),
+        ("verdict", "FORWARDED", {}),
+        ("drop_reason", "Policy denied (L3/L4)",
+         {"verdict": "DROPPED", "drop_reason": "Policy denied (L3/L4)"}),
+        ("l7_protocol", "http", {"l7_protocol": "http"}),
+        ("l7_method", "GET", {"l7_protocol": "http",
+                              "l7_method": "GET"}),
+        ("l7_status", 403, {"l7_protocol": "http", "l7_status": 403}),
+        ("node", "n1", {}),
+    ])
+    def test_each_predicate_match_and_reject(self, field, value,
+                                             flow_kw):
+        flt = FlowFilter(**{field: value})
+        assert flt.matches(_flow(**flow_kw))
+        # a flow differing in that one field must not match
+        wrong = {"src_identity": 1, "dst_identity": 1, "endpoint": 9,
+                 "dport": 81, "proto": 17, "verdict": "DROPPED",
+                 "drop_reason": "Prefilter denied",
+                 "l7_protocol": "dns", "l7_method": "PUT",
+                 "l7_status": 200, "node": "other"}
+        assert not flt.matches(_flow(**{**flow_kw,
+                                        field: wrong[field]}))
+
+    def test_identity_matches_either_side(self):
+        flt = FlowFilter(identity=512)
+        assert flt.matches(_flow(src_identity=512, dst_identity=9))
+        assert flt.matches(_flow(src_identity=9, dst_identity=512))
+        assert not flt.matches(_flow(src_identity=9, dst_identity=8))
+
+    def test_l7_path_is_prefix_match(self):
+        flt = FlowFilter(l7_path="/api/")
+        assert flt.matches(_flow(l7_protocol="http",
+                                 l7_path="/api/v1/users"))
+        assert not flt.matches(_flow(l7_protocol="http",
+                                     l7_path="/public/x"))
+
+    def test_since_cursor_excludes_older(self):
+        flt = FlowFilter(since=5)
+        assert not flt.matches(_flow(seq=5))
+        assert flt.matches(_flow(seq=6))
+
+    def test_conjunction(self):
+        flt = FlowFilter(verdict="DROPPED", dport=443, proto=6,
+                         src_identity=256)
+        hit = _flow(verdict="DROPPED", dport=443)
+        assert flt.matches(hit)
+        assert not flt.matches(_flow(verdict="DROPPED", dport=80))
+        assert not flt.matches(_flow(verdict="FORWARDED", dport=443))
+
+    def test_from_query_round_trip(self):
+        flt = FlowFilter.from_query({
+            "verdict": ["dropped"], "proto": ["tcp"],
+            "identity": ["256"], "dport": ["443"],
+            "drop_reason": ["-133"], "l7_path": ["/x"]})
+        assert flt.verdict == "DROPPED"
+        assert flt.proto == 6
+        assert flt.identity == 256
+        assert flt.dport == 443
+        assert flt.drop_reason == "Prefilter denied"
+        back = FlowFilter.from_query(flt.to_query())
+        assert back == flt
+
+    def test_to_query_strips_cursor_and_node(self):
+        q = FlowFilter(since=9, node="n1", dport=80).to_query()
+        assert "since" not in q and "node" not in q
+        assert q["dport"] == "80"
+
+    def test_parse_helpers_and_errors(self):
+        assert parse_proto("UDP") == 17
+        assert parse_proto(58) == 58
+        assert parse_verdict("redirected") == "REDIRECTED"
+        assert parse_drop_reason("prefilter denied") == \
+            "Prefilter denied"
+        with pytest.raises(ValueError):
+            parse_verdict("nope")
+        with pytest.raises(ValueError):
+            parse_drop_reason("no such reason")
+        with pytest.raises(ValueError):
+            parse_drop_reason("-1")
+
+    def test_verdict_of_event(self):
+        from cilium_tpu.datapath.events import (DROP_POLICY,
+                                                TRACE_TO_LXC,
+                                                TRACE_TO_PROXY)
+        assert verdict_of_event(DROP_POLICY) == "DROPPED"
+        assert verdict_of_event(TRACE_TO_PROXY) == "REDIRECTED"
+        assert verdict_of_event(TRACE_TO_LXC) == "FORWARDED"
+
+
+# ------------------------------------------------- device-oracle parity
+
+class TestAggregationOracle:
+    def _random_batches(self, seed, batches=4, b=512):
+        rng = np.random.default_rng(seed)
+        for it in range(batches):
+            yield (rng.integers(256, 280, b),
+                   rng.integers(256, 280, b),
+                   rng.integers(1, 5, b) * 1000,
+                   np.where(rng.random(b) < 0.5, 6, 17),
+                   rng.choice([-130, -133, -134, 0, 1, 4], b),
+                   rng.integers(40, 1500, b),
+                   100 + it)
+
+    @pytest.mark.parametrize("seed", [0, 7, 23])
+    def test_counters_bit_exact_vs_numpy_oracle(self, seed):
+        # ls_stripe=1: last-seen exact per batch (the parity config);
+        # counters are exact at every stripe
+        ft = FlowTable(slots=1 << 14, max_probe=16, ls_stripe=1)
+        oracle = {}
+        for (src, dst, dport, proto, event, length, now) in \
+                self._random_batches(seed):
+            ft.update(src, dst, dport, proto, event, length, now)
+            o = aggregate_oracle(src, dst, dport, proto, event,
+                                 length, now)
+            for k, (p, by, ls) in o.items():
+                p0, b0, l0 = oracle.get(k, (0, 0, 0))
+                oracle[k] = ((p0 + p) & 0xFFFFFFFF,
+                             (b0 + by) & 0xFFFFFFFF, max(l0, ls))
+        assert ft.lost == 0
+        dev = snapshot_to_oracle_form(ft.snapshot())
+        assert dev == oracle  # bit-exact: packets, bytes, last_seen
+
+    def test_uint32_byte_counter_wrap_matches_oracle(self):
+        ft = FlowTable(slots=1 << 6, max_probe=8, ls_stripe=1)
+        src = np.full(8, 256)
+        dst = np.full(8, 512)
+        dport = np.full(8, 80)
+        proto = np.full(8, 6)
+        event = np.zeros(8, np.int32)
+        length = np.full(8, 0x7FFFFFF0)
+        for now in (1, 2):
+            ft.update(src, dst, dport, proto, event, length, now)
+        oracle = {}
+        for now in (1, 2):
+            o = aggregate_oracle(src, dst, dport, proto, event,
+                                 length, now)
+            for k, (p, by, ls) in o.items():
+                p0, b0, l0 = oracle.get(k, (0, 0, 0))
+                oracle[k] = (p0 + p, (b0 + by) & 0xFFFFFFFF,
+                             max(l0, ls))
+        assert snapshot_to_oracle_form(ft.snapshot()) == oracle
+
+    def test_table_exhaustion_counts_lost_not_corrupt(self):
+        # 16 slots, hundreds of distinct keys: most rows are lost, and
+        # the tracked flows' counters stay exact
+        ft = FlowTable(slots=16, max_probe=4, ls_stripe=1)
+        rng = np.random.default_rng(1)
+        b = 512
+        src = rng.integers(0, 1 << 20, b)
+        ft.update(src, src, np.full(b, 80), np.full(b, 6),
+                  np.zeros(b, np.int64), np.full(b, 100), now=5)
+        assert ft.lost > 0
+        snap = ft.snapshot()
+        assert 0 < len(snap) <= 16
+        tracked = sum(f["packets"] for f in snap)
+        assert tracked + ft.lost == b
+
+    def test_claim_budget_throttles_births(self):
+        ft = FlowTable(slots=1 << 12, max_probe=8, claim_budget=64,
+                       ls_stripe=1)
+        rng = np.random.default_rng(2)
+        b = 512
+        src = rng.integers(0, 1 << 20, b)  # ~all distinct flows
+        args = (src, src, np.full(b, 80), np.full(b, 6),
+                np.zeros(b, np.int64), np.full(b, 100))
+        ft.update(*args, now=1)
+        first = ft.entry_count()
+        assert first <= 64
+        for i in range(12):
+            ft.update(*args, now=2 + i)
+        assert ft.entry_count() > first  # births continue over batches
+
+    def test_fused_pipeline_matches_monitor_view(self):
+        """The in-pipeline aggregation (engine path) keys flows by the
+        endpoint's own identity and the resolved peer identity."""
+        from cilium_tpu.datapath.engine import Datapath, make_full_batch
+        from cilium_tpu.policy.mapstate import (EGRESS, PolicyKey,
+                                                PolicyMapState,
+                                                PolicyMapStateEntry)
+        st = PolicyMapState()
+        st[PolicyKey(identity=256, dest_port=80, nexthdr=6,
+                     direction=EGRESS)] = PolicyMapStateEntry()
+        dp = Datapath(ct_slots=1 << 10)
+        dp.enable_flow_aggregation(slots=1 << 10, claim_every=1)
+        dp.load_policy([st], revision=1,
+                       ipcache_prefixes={"10.0.0.0/24": 256})
+        dp.set_endpoint_identity(0, 999)
+        pkt = make_full_batch(
+            endpoint=[0, 0, 0, 0],
+            saddr=["10.1.1.1"] * 4,
+            daddr=["10.0.0.5", "10.0.0.5", "10.0.0.9", "10.0.0.5"],
+            sport=[1111, 1112, 1113, 1111],
+            dport=[80, 80, 443, 80], length=[100, 200, 300, 400])
+        dp.process(pkt, now=50)
+        snap = {(f["src-identity"], f["dst-identity"], f["dport"],
+                 f["event"]): (f["packets"], f["bytes"], f["last-seen"])
+                for f in dp.flow_snapshot()}
+        from cilium_tpu.datapath.events import DROP_POLICY
+        assert snap[(999, 256, 80, 0)] == (3, 700, 50)
+        assert snap[(999, 256, 443, DROP_POLICY)] == (1, 300, 50)
+        # v6 shares the identity-keyed table
+        from cilium_tpu.datapath.engine import make_full_batch6
+        b6 = make_full_batch6(endpoint=[0], saddr=["fd00::1"],
+                              daddr=["fd00::2"], sport=[1], dport=[53],
+                              proto=[17], length=[80])
+        dp.process6(b6, now=51)
+        snap2 = dp.flow_snapshot()
+        assert any(f["proto"] == 17 and f["dport"] == 53
+                   for f in snap2)
+        stats = dp.flow_stats()
+        assert stats["occupied"] == len(snap2)
+        assert stats["claim-every"] == 1
+
+    def test_sharded_update_matches_oracle(self):
+        """Replicated table + batch-sharded inputs on the 8-device
+        virtual mesh produce the same aggregates."""
+        import functools
+
+        import jax
+        from cilium_tpu.hubble.aggregation import place_sharded
+        from cilium_tpu.parallel.mesh import (batch_sharding, make_mesh,
+                                              replicate)
+        mesh = make_mesh()
+        rng = np.random.default_rng(5)
+        b = 1024
+        src = rng.integers(256, 270, b).astype(np.int32)
+        dst = rng.integers(256, 270, b).astype(np.int32)
+        dport = rng.integers(1, 4, b).astype(np.int32) * 100
+        proto = np.full(b, 6, np.int32)
+        event = np.zeros(b, np.int32)
+        length = np.full(b, 64, np.int32)
+        slots = 1 << 12
+        state = place_sharded(make_flow_state(slots), mesh)
+        import jax.numpy as jnp
+        sh = batch_sharding(mesh)
+        args = [jax.device_put(jnp.asarray(a), sh)
+                for a in (src, dst, dport, proto, event, length)]
+        step = jax.jit(functools.partial(
+            flow_update_step, slots=slots, max_probe=8, ls_stripe=1))
+        state = step(state, *args, jnp.int32(7))
+        ft = FlowTable(slots=slots, max_probe=8, ls_stripe=1)
+        ft.state = state
+        dev = snapshot_to_oracle_form(ft.snapshot())
+        assert dev == aggregate_oracle(src, dst, dport, proto, event,
+                                       length, 7)
+
+
+# ------------------------------------------------------------ flow store
+
+class TestFlowStore:
+    def test_monotonic_seq_and_since(self):
+        store = FlowStore(capacity=100)
+        for i in range(10):
+            store.add(_flow(seq=0, dport=i))
+        assert store.last_seq == 10
+        assert [f.seq for f in store.get(limit=0)] == \
+            list(range(1, 11))
+        tail = store.get(since=7, limit=0)
+        assert [f.seq for f in tail] == [8, 9, 10]
+
+    def test_eviction_accounted(self):
+        store = FlowStore(capacity=5)
+        for i in range(12):
+            store.add(_flow())
+        assert store.stats()["ringed"] == 5
+        assert store.evicted == 7
+        assert [f.seq for f in store.get(limit=0)] == \
+            list(range(8, 13))
+
+    def test_filtered_get_with_limit(self):
+        store = FlowStore(capacity=100)
+        for i in range(20):
+            store.add(_flow(verdict="DROPPED" if i % 2 else
+                            "FORWARDED"))
+        drops = store.get(FlowFilter(verdict="DROPPED"), limit=3)
+        assert len(drops) == 3
+        assert all(f.verdict == "DROPPED" for f in drops)
+        # newest matches win when more qualify
+        assert drops[-1].seq == 20
+
+
+# ----------------------------------------------------- observer ingestion
+
+class TestObserver:
+    def test_monitor_and_access_log_become_flows(self):
+        hub = MonitorHub()
+        obs = FlowObserver(node="nX")
+        obs.attach_monitor(hub)
+        hub.ingest_batch(np.array([-130, 0]), np.array([1, 2]),
+                         np.array([256, 257]), np.array([80, 81]),
+                         np.array([6, 6]), np.array([100, 200]))
+        flows = obs.get_flows(limit=10)
+        assert len(flows) == 2
+        drop = [f for f in flows if f["verdict"] == "DROPPED"][0]
+        assert drop["drop_reason"] == "Policy denied (L3/L4)"
+        assert drop["node"] == "nX"
+
+        from cilium_tpu.proxy import AccessLogEntry
+        obs._on_access_log(AccessLogEntry(
+            timestamp=time.time(), proxy_id="1:ingress:TCP:80",
+            l7_protocol="http", verdict="denied", src_identity=9,
+            dst_identity=10,
+            info={"method": "GET", "path": "/admin", "status": 403}))
+        l7 = obs.get_flows(FlowFilter(l7_protocol="http"), limit=10)
+        assert len(l7) == 1
+        assert l7[0]["verdict"] == "DROPPED"
+        assert l7[0]["l7_method"] == "GET"
+        assert l7[0]["l7_status"] == 403
+
+    def test_agent_and_l7_monitor_notes_are_skipped(self):
+        hub = MonitorHub()
+        obs = FlowObserver(node="nX")
+        obs.attach_monitor(hub)
+        hub.notify_agent("policy-updated", "revision=1")
+        assert obs.get_flows(limit=10) == []
+
+
+# ------------------------------------------------------ relay degradation
+
+class _LocalPeer:
+    """In-process peer: a FlowStore behind the fetch contract."""
+
+    def __init__(self, node):
+        self.store = FlowStore()
+        self.node = node
+
+    def fetch(self, query, since, limit):
+        flt = FlowFilter.from_query(query)
+        return {"flows": [f.to_dict() for f in
+                          self.store.get(flt, since=since,
+                                         limit=limit)]}
+
+
+class TestRelay:
+    def _relay_with_two_peers(self):
+        a, b = _LocalPeer("a"), _LocalPeer("b")
+        for i in range(3):
+            a.store.add(_flow(node="a", dport=80))
+            b.store.add(_flow(node="b", dport=443,
+                              verdict="DROPPED"))
+        relay = HubbleRelay(deadline_s=0.5)
+        relay.add_peer("a", a.fetch)
+        relay.add_peer("b", b.fetch)
+        return relay, a, b
+
+    def test_federated_merge(self):
+        relay, _a, _b = self._relay_with_two_peers()
+        out = relay.get_flows(limit=10)
+        assert not out["partial"]
+        assert len(out["flows"]) == 6
+        assert {n["name"] for n in out["nodes"]} == {"a", "b"}
+        assert all(n["status"] == "ok" for n in out["nodes"])
+        # filters fan out to peers
+        drops = relay.get_flows(FlowFilter(verdict="DROPPED"),
+                                limit=10)
+        assert len(drops["flows"]) == 3
+        assert all(f["node"] == "b" for f in drops["flows"])
+
+    def test_dead_peer_degrades_to_flagged_partial(self):
+        relay, _a, _b = self._relay_with_two_peers()
+
+        def dead(query, since, limit):
+            raise ConnectionRefusedError("peer down")
+
+        relay.add_peer("dead", dead)
+        out = relay.get_flows(limit=10)
+        assert out["partial"]
+        assert len(out["flows"]) == 6  # live peers still answer
+        status = {n["name"]: n["status"] for n in out["nodes"]}
+        assert status["dead"] == "error"
+        assert status["a"] == "ok"
+
+    def test_hung_peer_times_out_not_hangs(self):
+        relay, _a, _b = self._relay_with_two_peers()
+        release = threading.Event()
+
+        def hung(query, since, limit):
+            release.wait(30)
+            return {"flows": []}
+
+        relay.add_peer("hung", hung)
+        t0 = time.monotonic()
+        out = relay.get_flows(limit=10)
+        elapsed = time.monotonic() - t0
+        release.set()
+        assert elapsed < 5.0  # bounded by the 0.5s deadline, not 30s
+        assert out["partial"]
+        status = {n["name"]: n["status"] for n in out["nodes"]}
+        assert status["hung"] == "timeout"
+        assert len(out["flows"]) == 6
+
+    def test_breaker_opens_and_recovers(self):
+        relay, a, _b = self._relay_with_two_peers()
+        state = {"up": False}
+
+        def flaky(query, since, limit):
+            if not state["up"]:
+                raise ConnectionRefusedError("down")
+            return {"flows": [_flow(node="flaky").to_dict()]}
+
+        relay.add_peer("flaky", flaky)
+        # threshold=2 failures -> open
+        relay.get_flows(limit=5)
+        relay.get_flows(limit=5)
+        out = relay.get_flows(limit=5)
+        status = {n["name"]: n for n in out["nodes"]}
+        assert status["flaky"]["status"] == "breaker-open"
+        assert status["flaky"]["breaker"] in ("open", "half-open")
+        # recovery: wait out the reset timeout, peer comes back
+        state["up"] = True
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            out = relay.get_flows(limit=5)
+            st = {n["name"]: n["status"] for n in out["nodes"]}
+            if st["flaky"] == "ok":
+                break
+            time.sleep(0.1)
+        assert st["flaky"] == "ok"
+        assert not out["partial"]
+        health = {h["name"]: h for h in relay.node_health()}
+        assert health["flaky"]["breaker"] == "closed"
+
+
+# ----------------------------------------------------- monitor cursor
+
+class TestMonitorCursor:
+    def _burst(self, hub, n, code=0):
+        hub.ingest_batch(np.full(n, code), np.zeros(n, int),
+                         np.zeros(n, int), np.zeros(n, int),
+                         np.full(n, 6), np.full(n, 100))
+
+    def test_seq_monotonic_and_since(self):
+        hub = MonitorHub(samples_per_batch=8)
+        self._burst(hub, 4)
+        self._burst(hub, 4)
+        events = hub.tail(100)
+        seqs = [e.seq for e in events]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        cursor = seqs[3]
+        later = hub.tail(100, since=cursor)
+        assert [e.seq for e in later] == seqs[4:]
+        assert hub.last_seq == seqs[-1]
+
+    def test_cursor_sees_burst_beyond_page_size(self):
+        """Events between polls beyond one page are not silently
+        missed: a since-poll pages FORWARD (oldest-first) from the
+        cursor, so the follower drains a burst page by page (the
+        pre-cursor CLI capped at the newest n and dropped the middle).
+        since=0 keeps the legacy newest-n view (the first poll)."""
+        hub = MonitorHub(samples_per_batch=16)
+        self._burst(hub, 1)
+        first = hub.tail(10)          # since unset: newest view
+        cursor = first[-1].seq
+        got = []
+        for _ in range(6):  # 6 bursts x 32 samples, paged 10 at a time
+            self._burst(hub, 16)
+            self._burst(hub, 16, code=-130)
+            while True:
+                page = hub.tail(10, since=cursor)
+                if not page:
+                    break
+                got.extend(e.seq for e in page)
+                cursor = page[-1].seq
+        assert got == list(range(first[-1].seq + 1,
+                                 hub.last_seq + 1))
+
+    def test_agent_events_and_wire_dict_carry_seq(self):
+        from cilium_tpu.monitor import _monitor_event_dict
+        hub = MonitorHub()
+        hub.notify_agent("endpoint-created", "id=5")
+        ev = hub.tail(1)[0]
+        assert ev.seq == 1
+        assert _monitor_event_dict(ev)["seq"] == 1
+
+
+# ---------------------------------------------- prometheus conformance
+
+class TestHubbleMetricsConformance:
+    def _fresh_series(self):
+        # the process-global registry is shared; craft label sets
+        # unique to this test so assertions are stable
+        from cilium_tpu.utils.metrics import registry
+        return registry
+
+    def test_counter_label_escaping(self):
+        from cilium_tpu.utils.metrics import HUBBLE_DROPS, registry
+        HUBBLE_DROPS.inc(labels={
+            "reason": 'weird "quoted" back\\slash\nnewline',
+            "src_identity": "77701", "dst_identity": "77702"})
+        text = registry.expose_text()
+        line = [l for l in text.splitlines()
+                if "77701" in l and "hubble_drop_total" in l]
+        assert len(line) == 1
+        assert '\\"quoted\\"' in line[0]
+        assert "back\\\\slash" in line[0]
+        assert "\\n" in line[0] and "\n" not in \
+            line[0].replace("\\n", "")
+
+    @staticmethod
+    def _relay_hist_lines(text):
+        return {l.rsplit(" ", 1)[0]: float(l.rsplit(" ", 1)[1])
+                for l in text.splitlines()
+                if l.startswith("cilium_tpu_hubble_relay_peer_seconds")}
+
+    def test_histogram_buckets_sum_count(self):
+        # delta-based: the registry is process-global, so earlier
+        # relay tests may already have observations in this series
+        from cilium_tpu.utils.metrics import (HUBBLE_RELAY_SECONDS,
+                                              registry)
+        before = self._relay_hist_lines(registry.expose_text())
+        for v in (0.0002, 0.003, 0.003, 0.2, 7.0):
+            HUBBLE_RELAY_SECONDS.observe(v)
+        text = registry.expose_text()
+        after = self._relay_hist_lines(text)
+        buckets = {k: v for k, v in after.items() if "_bucket" in k}
+        assert buckets, text
+        # cumulative, monotone nondecreasing in bucket order
+        ordered = [v for k, v in after.items() if "_bucket" in k]
+        assert ordered == sorted(ordered)
+        inf_key = [k for k in buckets if 'le="+Inf"' in k]
+        assert len(inf_key) == 1
+        count_key = [k for k in after if k.endswith("_count")][0]
+        sum_key = [k for k in after if k.endswith("_sum")][0]
+        # +Inf == _count, both grew by exactly the 5 observations
+        assert after[inf_key[0]] == after[count_key]
+        assert after[count_key] - before.get(count_key, 0.0) == 5.0
+        assert abs((after[sum_key] - before.get(sum_key, 0.0)) -
+                   7.2062) < 1e-6
+        # the le="0.001" bucket gained only the 0.0002 observation
+        small = [k for k in buckets if 'le="0.001"' in k][0]
+        assert after[small] - before.get(small, 0.0) == 1.0
+        # TYPE declared
+        assert "# TYPE cilium_tpu_hubble_relay_peer_seconds histogram" \
+            in text
+
+    def test_flow_derived_series(self):
+        from cilium_tpu.utils.metrics import (HUBBLE_DNS_RESPONSES,
+                                              HUBBLE_DROPS,
+                                              HUBBLE_FLOWS_PROCESSED,
+                                              HUBBLE_HTTP_RESPONSES)
+        obs = FlowObserver(node="metrics-test")
+        before = HUBBLE_FLOWS_PROCESSED.total()
+        obs.ingest(_flow(verdict="DROPPED",
+                         drop_reason="Prefilter denied",
+                         src_identity=88801, dst_identity=88802))
+        obs.ingest(_flow(l7_protocol="http", l7_method="GET",
+                         l7_status=503))
+        obs.ingest(_flow(l7_protocol="dns", l7_status=3))
+        assert HUBBLE_FLOWS_PROCESSED.total() == before + 3
+        assert HUBBLE_DROPS.value(labels={
+            "reason": "Prefilter denied", "src_identity": "88801",
+            "dst_identity": "88802"}) == 1
+        assert HUBBLE_HTTP_RESPONSES.value(labels={
+            "status": "503", "method": "GET"}) >= 1
+        assert HUBBLE_DNS_RESPONSES.value(labels={"rcode": "3"}) >= 1

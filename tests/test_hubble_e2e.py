@@ -1,0 +1,318 @@
+"""Hubble end-to-end: live daemon + REST /flows + `cilium hubble
+observe`, registry-driven relay federation with a peer killed
+mid-query, bugtool/debuginfo flow members, and the L7 feeds (DNS
+poller rcodes, HTTP response-status sampling)."""
+
+import io
+import json
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.cli import Client, main as cli_main
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.daemon.rest import APIServer
+from cilium_tpu.datapath.engine import make_full_batch
+from cilium_tpu.utils.option import DaemonConfig
+
+RULES_JSON = """
+[{
+  "endpointSelector": {"matchLabels": {"id": "server"}},
+  "ingress": [
+    {"fromEndpoints": [{"matchLabels": {"id": "client"}}]}
+  ],
+  "labels": ["k8s:policy=web"]
+}]
+"""
+
+
+@pytest.fixture
+def agent(tmp_path):
+    cfg = DaemonConfig(state_dir=str(tmp_path / "state"))
+    d = Daemon(config=cfg, builders=2)
+    server = APIServer(d).start()
+    yield d, server
+    server.shutdown()
+    d.shutdown()
+
+
+def _cli(server, *argv):
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        rc = cli_main(["--api", server.base_url, *argv])
+    finally:
+        sys.stdout = old
+    return rc, out.getvalue()
+
+
+def _drive_traffic(d, c):
+    """Endpoints + policy + one processed batch; returns the
+    identities dict and the dropped flow's dport."""
+    c.put("/endpoint/100", {"ipv4": "10.0.0.10",
+                            "labels": ["k8s:id=server"]})
+    c.put("/endpoint/200", {"ipv4": "10.0.0.20",
+                            "labels": ["k8s:id=client"]})
+    c.request("PUT", "/policy", json.loads(RULES_JSON))
+    assert d.wait_for_policy_revision()
+    idents = {tuple(i["labels"]): i["id"] for i in c.get("/identity")}
+    client_id = idents[("k8s:id=client",)]
+    slot = d.endpoints.lookup(100).table_slot
+    batch = make_full_batch(
+        endpoint=[slot, slot], saddr=["10.0.0.20", "10.99.0.9"],
+        daddr=["10.0.0.10"] * 2, sport=[40000, 40001],
+        dport=[9999, 22], direction=[0, 0], length=[111, 222])
+    verdict, event, identity, _nat = d.datapath.process(batch,
+                                                        now=1234)
+    v = np.asarray(verdict)
+    assert v[0] == 0 and v[1] < 0
+    d.monitor.ingest_batch(np.asarray(event),
+                           np.asarray(batch.endpoint),
+                           np.asarray(identity),
+                           np.asarray(batch.dport),
+                           np.asarray(batch.proto),
+                           np.asarray(batch.length))
+    return client_id
+
+
+def test_flows_rest_and_cli_observe(agent):
+    d, server = agent
+    c = Client(server.base_url)
+    client_id = _drive_traffic(d, c)
+
+    # REST: unfiltered, then filtered by verdict + identity
+    out = c.get("/flows?n=50")
+    assert out["node"] == d.node_name
+    assert len(out["flows"]) == 2
+    drops = c.get(f"/flows?verdict=DROPPED&n=50")
+    assert len(drops["flows"]) == 1
+    assert drops["flows"][0]["dport"] == 22
+    assert drops["flows"][0]["drop_reason"]
+    allowed = c.get(f"/flows?verdict=FORWARDED&identity={client_id}")
+    assert len(allowed["flows"]) == 1
+    assert allowed["flows"][0]["src_identity"] == client_id
+    # bad predicate -> 400
+    with pytest.raises(SystemExit):
+        c.get("/flows?verdict=BOGUS")
+
+    # the acceptance-path CLI: filtered observe against the live agent
+    rc, text = _cli(server, "hubble", "observe", "--verdict",
+                    "DROPPED", "--identity", str(2))
+    assert rc == 0
+    # identity 2 == WORLD (the unknown 10.99.0.9 source)
+    assert "DROPPED" in text and "dport=22" in text
+    rc, text = _cli(server, "hubble", "observe", "--verdict",
+                    "DROPPED", "--identity", str(client_id))
+    assert rc == 0 and "DROPPED" not in text  # client flow was allowed
+    rc, text = _cli(server, "hubble", "observe", "--json", "-n", "5")
+    assert rc == 0
+    lines = [json.loads(l) for l in text.strip().splitlines()]
+    assert len(lines) == 2
+
+    # stats: store + on-device aggregation visible
+    rc, text = _cli(server, "hubble", "stats", "--aggregated")
+    assert rc == 0
+    stats = json.loads(text)
+    assert stats["store"]["seq"] == 2
+    assert stats["aggregation"]["occupied"] >= 2
+    agg = {(f["src-identity"], f["dport"]): f for f in stats["flows"]}
+    assert (client_id, 9999) in agg
+    assert agg[(client_id, 9999)]["bytes"] == 111
+
+    # the device table also rides the map-dump surface
+    inv = c.get("/map")
+    assert "hubble-flows" in inv
+    dump = c.get("/map/hubble-flows")
+    assert len(dump) == stats["aggregation"]["occupied"]
+
+
+def test_flows_since_cursor_pages_forward(agent):
+    d, server = agent
+    c = Client(server.base_url)
+    _drive_traffic(d, c)
+    first = c.get("/flows?n=50")
+    cursor = first["flows"][0]["seq"]  # oldest flow's cursor
+    rest = c.get(f"/flows?since={cursor}&n=50")
+    seqs = [f["seq"] for f in rest["flows"]]
+    assert seqs == [f["seq"] for f in first["flows"][1:]]
+    assert all(s > cursor for s in seqs)
+
+
+def test_monitor_since_cursor_over_rest(agent):
+    d, server = agent
+    c = Client(server.base_url)
+    _drive_traffic(d, c)
+    events = c.get("/monitor?n=100")
+    assert all("seq" in e for e in events)
+    cursor = events[1]["seq"]
+    later = c.get(f"/monitor?since={cursor}&n=100")
+    assert [e["seq"] for e in later] == \
+        [e["seq"] for e in events if e["seq"] > cursor]
+
+
+def test_relay_federation_with_peer_killed_mid_query(tmp_path):
+    """Two simulated nodes federate /flows through the registry; one
+    is killed and the federated answer degrades to a flagged partial,
+    then recovers when the peer returns."""
+    from cilium_tpu.kvstore.memory import InMemoryBackend, MemStore
+
+    store = MemStore()
+    daemons, servers = [], []
+    for i, name in enumerate(("node-a", "node-b")):
+        cfg = DaemonConfig(state_dir=str(tmp_path / name))
+        d = Daemon(config=cfg, kvstore_backend=InMemoryBackend(store),
+                   node_name=name)
+        server = APIServer(d).start()
+        # publish the node WITH its hubble observer address: peers'
+        # relays discover it through the shared registry
+        d.register_node(f"10.50.0.{i + 1}", f"10.6{i}.0.0/16",
+                        hubble_address=server.base_url)
+        daemons.append(d)
+        servers.append(server)
+    try:
+        a, b = daemons
+        # distinct flows on each node
+        for d, dport in ((a, 80), (b, 443)):
+            from cilium_tpu.hubble.flow import FlowRecord
+            d.hubble.ingest(FlowRecord(
+                seq=0, timestamp=time.time(), node=d.node_name,
+                verdict="FORWARDED", src_identity=300,
+                dst_identity=400, dport=dport, proto=6))
+
+        def wait_for(fn, timeout=5.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if fn():
+                    return True
+                time.sleep(0.05)
+            return fn()
+
+        # both relays see both nodes (self + registry peer)
+        assert wait_for(lambda: len(a.hubble_relay.peers()) == 2)
+        ca = Client(servers[0].base_url)
+        out = ca.get("/flows?federated=true&n=50")
+        assert not out["partial"]
+        assert {f["dport"] for f in out["flows"]} == {80, 443}
+        assert {n["name"] for n in out["nodes"]} == \
+            {"node-a", "default/node-b"}
+
+        # kill node-b's API server: the next federated query must
+        # fail open with node-b flagged, node-a's flows intact
+        servers[1].shutdown()
+        out = ca.get("/flows?federated=true&n=50")
+        assert out["partial"]
+        status = {n["name"]: n["status"] for n in out["nodes"]}
+        assert status["node-a"] == "ok"
+        assert status["default/node-b"] in ("error", "timeout",
+                                            "breaker-open")
+        assert {f["dport"] for f in out["flows"]} == {80}
+        # repeat queries trip the breaker to a bounded probe cadence
+        ca.get("/flows?federated=true&n=50")
+        out = ca.get("/flows?federated=true&n=50")
+        status = {n["name"]: n for n in out["nodes"]}
+        health = {h["name"]: h for h in a.hubble_relay.node_health()}
+        assert health["default/node-b"]["breaker"] in ("open",
+                                                       "half-open")
+
+        # recovery: restart node-b's observer on the SAME port
+        servers[1] = APIServer(daemons[1],
+                               port=servers[1].port).start()
+
+        def recovered():
+            out = ca.get("/flows?federated=true&n=50")
+            return not out["partial"] and \
+                {f["dport"] for f in out["flows"]} == {80, 443}
+
+        assert wait_for(recovered, timeout=8.0)
+        # relay health reflects the closed breaker again
+        health = {h["name"]: h for h in a.hubble_relay.node_health()}
+        assert health["default/node-b"]["breaker"] == "closed"
+        # federated CLI shows the merged stream
+        rc, text = _cli(servers[0], "hubble", "observe", "--federated",
+                        "--json")
+        assert rc == 0
+        assert {json.loads(l)["dport"]
+                for l in text.strip().splitlines()} == {80, 443}
+    finally:
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+        for d in daemons:
+            d.shutdown()
+
+
+def test_bugtool_and_debuginfo_include_flow_state(agent, tmp_path):
+    d, server = agent
+    c = Client(server.base_url)
+    _drive_traffic(d, c)
+
+    # in-process bugtool archive
+    import tarfile
+    from cilium_tpu.bugtool import collect
+    path = collect(d, str(tmp_path / "bt.tar.gz"))
+    with tarfile.open(path) as tar:
+        names = {n.split("/", 1)[1] for n in tar.getnames()}
+        assert "hubble-flows.json" in names
+        assert "hubble-aggregation.json" in names
+        assert "hubble-relay.json" in names
+        member = [n for n in tar.getnames()
+                  if n.endswith("hubble-aggregation.json")][0]
+        agg = json.load(tar.extractfile(member))
+        assert agg["stats"]["occupied"] >= 2
+        assert len(agg["flows"]) == agg["stats"]["occupied"]
+
+    # remote (CLI-path) bugtool
+    from cilium_tpu.bugtool import collect_remote
+    rpath = collect_remote(c, str(tmp_path / "btr.tar.gz"))
+    with tarfile.open(rpath) as tar:
+        names = {n.split("/", 1)[1] for n in tar.getnames()}
+        assert "hubble-flows.json" in names
+        assert "hubble-stats.json" in names
+
+    # debuginfo carries the hubble block
+    info = c.get("/debuginfo")
+    assert info["hubble"] is not None
+    assert len(info["hubble"]["flows"]) == 2
+    assert info["hubble"]["aggregation"]["occupied"] >= 2
+    assert isinstance(info["hubble"]["relay"], list)
+
+
+def test_dns_poller_feeds_flow_stream(agent):
+    d, server = agent
+    c = Client(server.base_url)
+
+    def lookup(names):
+        return {n: (["1.2.3.4"], 60) if n.startswith("ok")
+                else ([], 30) for n in names}
+
+    poller = d.start_fqdn_poller(lookup, interval=3600)
+    poller._names.update({"ok.example.com", "missing.example.com"})
+    poller.poll_once()
+    flows = c.get("/flows?l7_protocol=dns&n=50")["flows"]
+    by_name = {f["l7_path"]: f for f in flows}
+    assert by_name["ok.example.com"]["l7_status"] == 0
+    assert by_name["missing.example.com"]["l7_status"] == 3
+    from cilium_tpu.utils.metrics import HUBBLE_DNS_RESPONSES
+    assert HUBBLE_DNS_RESPONSES.value(labels={"rcode": "3"}) >= 1
+
+
+def test_http_status_line_parse():
+    from cilium_tpu.l7.http import parse_status_line
+    assert parse_status_line(b"HTTP/1.1 200 OK") == 200
+    assert parse_status_line(b"HTTP/1.0 403 Forbidden") == 403
+    assert parse_status_line(b"HTTP/1.1 abc") is None
+    assert parse_status_line(b"GET / HTTP/1.1") is None
+    assert parse_status_line(b"HTTP/1.1 9000 nope") is None
+
+
+def test_status_carries_hubble_block(agent):
+    d, server = agent
+    c = Client(server.base_url)
+    st = c.get("/healthz")
+    assert st["hubble"]["node"] == d.node_name
+    assert "store" in st["hubble"]

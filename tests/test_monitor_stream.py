@@ -101,13 +101,18 @@ def test_cli_monitor_follows_from_separate_process():
          "--socket", f"127.0.0.1:{server.port}"],
         stdout=subprocess.PIPE, text=True, cwd=REPO,
         env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    # in-process subscribers (the hubble observer) register at daemon
+    # construction; the CLI's arrival is the count going ABOVE that
+    base_subs = len(d.monitor._subscribers)
     try:
         # wait until the CLI's subscription is registered (its jax
         # import alone can take seconds)
         deadline = time.time() + 30
-        while not d.monitor._subscribers and time.time() < deadline:
+        while len(d.monitor._subscribers) <= base_subs and \
+                time.time() < deadline:
             time.sleep(0.1)
-        assert d.monitor._subscribers, "CLI never subscribed"
+        assert len(d.monitor._subscribers) > base_subs, \
+            "CLI never subscribed"
         _ingest(d.monitor, [-130, 0])
         lines = [proc.stdout.readline(), proc.stdout.readline()]
         blob = "".join(lines)
