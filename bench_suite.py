@@ -508,6 +508,101 @@ def bench_flows_overhead(on_accel: bool):
                       for k, v in times.items()}})
 
 
+def bench_tracing_overhead(on_accel: bool):
+    """Self-telemetry cost proof: v4 full-pipeline verdict throughput
+    with runtime telemetry (stage slices, jit-cache accounting,
+    deferred verdict-outcome counters, revision-served tracking) on vs
+    off.  Same real path both ways — Datapath.process over the 1000-
+    rule config-1 policy — with the engine's telemetry flag the only
+    difference.  Acceptance bar: <=2% verdict-throughput cost enabled;
+    the disabled leg IS the baseline (one boolean check per batch)."""
+    from bench import build_config1
+    from cilium_tpu.datapath.engine import Datapath, make_full_batch
+    from cilium_tpu.observability import jit_telemetry, tracer
+
+    states, prefixes = build_config1(n_rules=1000, n_endpoints=64)
+    batch = (1 << 20) if on_accel else (1 << 16)
+    rng = np.random.default_rng(13)
+    n_endpoints = len(states)
+
+    def make_dp(telemetry: bool) -> Datapath:
+        dp = Datapath(ct_slots=1 << 16)
+        dp.telemetry_enabled = telemetry
+        dp.load_policy(states, revision=1, ipcache_prefixes=prefixes)
+        for slot in range(n_endpoints):
+            dp.set_endpoint_identity(slot, 1000 + slot)
+        return dp
+
+    # steady-state traffic, identical batches both legs (the
+    # flows-overhead protocol: interleaved A/B rounds, min-of-rounds,
+    # so host-load spikes can't fake a single-digit-percent effect)
+    n_active_flows = 8192
+    sel = rng.integers(0, n_active_flows, batch)
+    pool = {
+        "endpoint": rng.integers(0, n_endpoints, n_active_flows),
+        "saddr": rng.integers(0, 1 << 32, n_active_flows,
+                              dtype=np.uint32),
+        "daddr": rng.integers(0, 1 << 32, n_active_flows,
+                              dtype=np.uint32),
+        "sport": rng.integers(1024, 65535, n_active_flows),
+        "dport": rng.integers(1, 65536, n_active_flows),
+    }
+    pkt = make_full_batch(
+        endpoint=pool["endpoint"][sel], saddr=pool["saddr"][sel],
+        daddr=pool["daddr"][sel], sport=pool["sport"][sel],
+        dport=pool["dport"][sel], length=np.full(batch, 256))
+
+    tracer_was = tracer.enabled
+    datapaths = {}
+    clocks = {}
+    try:
+        for label, telemetry in (("disabled", False),
+                                 ("enabled", True)):
+            tracer.enabled = telemetry
+            dp = make_dp(telemetry)
+            clocks[label] = 1000
+            for _ in range(8):  # settle CT entries + first compiles
+                clocks[label] += 1
+                dp.process(pkt, now=clocks[label])
+            datapaths[label] = dp
+
+        iters = 8
+        rounds = 5
+        times = {"disabled": [], "enabled": []}
+        for _ in range(rounds):
+            for label, dp in datapaths.items():
+                tracer.enabled = label == "enabled"
+
+                def step():
+                    clocks[label] += 1
+                    v, _e, _i, _n = dp.process(pkt, now=clocks[label])
+                    v.block_until_ready()
+
+                total, _p99 = _bench(step, iters, warmup=1)
+                times[label].append(total / iters)
+    finally:
+        tracer.enabled = tracer_was
+
+    base_s = float(np.min(times["disabled"]))
+    tel_s = float(np.min(times["enabled"]))
+    base = batch / base_s
+    tel = batch / tel_s
+    overhead_pct = round((tel_s - base_s) / base_s * 100, 2)
+    return _result(
+        "tracing_overhead_verdicts_per_sec", tel, "verdicts/s",
+        10_000_000.0,
+        {"batch": batch, "rounds": rounds,
+         "baseline_vps": round(base),
+         "telemetry_vps": round(tel),
+         "overhead_pct": overhead_pct,
+         "overhead_under_2pct": overhead_pct <= 2.0,
+         "jit_telemetry": {
+             k: v for k, v in jit_telemetry.report().items()
+             if k in ("cache-hits", "cache-misses")},
+         "round_ms": {k: [round(t * 1e3, 1) for t in v]
+                      for k, v in times.items()}})
+
+
 CONFIGS = {
     "identity-l4": bench_identity_l4,
     "http-regex": bench_http_regex,
@@ -516,6 +611,7 @@ CONFIGS = {
     "capacity": bench_capacity,
     "incremental": bench_incremental,
     "flows-overhead": bench_flows_overhead,
+    "tracing-overhead": bench_tracing_overhead,
 }
 
 

@@ -14,6 +14,8 @@ import tarfile
 import time
 from typing import Callable, Dict, Optional
 
+from .observability import jit_telemetry
+
 
 def _collectors(daemon) -> Dict[str, Callable[[], object]]:
     out = {
@@ -36,6 +38,17 @@ def _collectors(daemon) -> Dict[str, Callable[[], object]]:
             "services": len(daemon.datapath.lb),
             "prefilter": daemon.datapath.prefilter.dump()[0]},
         "metrics.txt": daemon.metrics_text,
+        # runtime self-telemetry (observability/): the span-trace
+        # buffer, device-table pressure, compile/jit-cache counters
+        # and the host pipeline-stage breakdown — one archive answers
+        # "what was the agent doing"
+        "traces.json": daemon.traces,
+        "map-pressure.json": lambda: daemon.datapath.map_pressure(
+            daemon.config.map_pressure_warn),
+        "compile-telemetry.json": lambda: {
+            "jit": jit_telemetry.report(),
+            "propagation": daemon.propagation.report(50)},
+        "pipeline.json": daemon.pipeline_report,
     }
     if getattr(daemon, "hubble", None) is not None:
         # flow observability state (hubble/): the recent flow ring, the
@@ -66,6 +79,8 @@ def _remote_collectors(client) -> Dict[str, Callable[[], object]]:
         "hubble-flows.json": lambda: client.get("/flows?n=500"),
         "hubble-stats.json":
         lambda: client.get("/flows/stats?aggregated=true"),
+        "traces.json": lambda: client.get("/debug/traces"),
+        "pipeline.json": lambda: client.get("/debug/pipeline"),
     }
 
 

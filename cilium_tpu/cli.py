@@ -126,6 +126,44 @@ def cmd_status(c: Client, args) -> int:
               f"{tr['verify-on-retry']} verified, "
               f"{tr['watch-relists']} relists, "
               f"{len(open_breakers)} breakers open")
+    mp = st.get("map-pressure") or {}
+    for warning in mp.get("warnings", []):
+        print(f"MapPressure:   WARNING {warning}")
+    if getattr(args, "verbose", False):
+        # self-telemetry detail (the status --verbose surface):
+        # per-map fill, compile/jit-cache accounting, tracer health,
+        # recent policy-propagation delays
+        for name, m in sorted(mp.get("maps", {}).items()):
+            if m.get("pressure") is not None:
+                print(f"Map:           {name:14s} "
+                      f"{m['occupied']}/{m['capacity']} "
+                      f"({m['pressure'] * 100:.1f}%)")
+            else:
+                print(f"Map:           {name:14s} "
+                      f"{m['occupied']} entries")
+        tel = st.get("telemetry") or {}
+        jit = tel.get("jit") or {}
+        if jit:
+            compiles = sum((jit.get("compiles") or {}).values())
+            secs = sum((jit.get("compile-seconds") or {}).values())
+            print(f"JIT:           {compiles} compiles "
+                  f"({secs:.2f}s), cache "
+                  f"{jit.get('cache-hits', 0)} hits / "
+                  f"{jit.get('cache-misses', 0)} misses, "
+                  f"{jit.get('device-bytes-total', 0) / 1e6:.1f}MB "
+                  f"device tables")
+        tracing = tel.get("tracing") or {}
+        if tracing:
+            state = "on" if tracing.get("enabled") else "off"
+            print(f"Tracing:       {state}, "
+                  f"{tracing.get('buffered', 0)}/"
+                  f"{tracing.get('capacity', 0)} spans buffered")
+        for rec in tel.get("propagation") or []:
+            delay = rec.get("first-verdict-delay-s")
+            state = f"{delay * 1000:.1f}ms to first verdict" \
+                if delay is not None else "awaiting first verdict"
+            print(f"PolicyRev:     r{rec['revision']} "
+                  f"({rec['rules']} rules): {state}")
     return 0
 
 
@@ -416,6 +454,48 @@ def cmd_hubble(c: Client, args) -> int:
         return 0
 
 
+def cmd_trace(c: Client, args) -> int:
+    """``cilium-tpu trace`` — the span-trace surface over
+    /debug/traces: recent trace summaries, or one rendered span tree
+    by trace id / policy revision."""
+    if args.id or args.revision is not None:
+        q = f"?id={args.id}" if args.id else \
+            f"?revision={args.revision}"
+        tree = c.get(f"/debug/traces{q}")
+        if args.json:
+            _print_json(tree)
+            return 0
+
+        def render(node, depth):
+            dur = node.get("duration-s") or 0.0
+            attrs = " ".join(
+                f"{k}={v}" for k, v in
+                sorted((node.get("attrs") or {}).items()))
+            print(f"{'  ' * depth}{node['name']:<40s} "
+                  f"{dur * 1000:10.3f}ms  {attrs}")
+            for child in node.get("children", []):
+                render(child, depth + 1)
+
+        print(f"Trace {tree['trace-id']}:")
+        for root in tree.get("spans", []):
+            render(root, 1)
+        return 0
+    out = c.get(f"/debug/traces?n={args.n}")
+    if args.json:
+        _print_json(out)
+        return 0
+    print(f"{'TRACE':<14} {'ROOT':<36} {'SPANS':>5} "
+          f"{'DURATION':>12}")
+    for t in out.get("traces", []):
+        print(f"{t['trace-id']:<14} {t['root']:<36} "
+              f"{t['spans']:>5} {t['duration-s'] * 1000:>10.3f}ms")
+    ts = out.get("tracer") or {}
+    print(f"({'enabled' if ts.get('enabled') else 'disabled'}, "
+          f"{ts.get('buffered', 0)}/{ts.get('capacity', 0)} spans "
+          f"buffered, {ts.get('dropped', 0)} evicted)")
+    return 0
+
+
 def cmd_config(c: Client, args) -> int:
     if not args.options:
         _print_json(c.get("/config"))
@@ -638,6 +718,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("status", help="agent health and state")
     sp.add_argument("--json", action="store_true")
+    sp.add_argument("-v", "--verbose", action="store_true",
+                    help="include map pressure, JIT/compile telemetry "
+                         "and policy-propagation delays")
 
     pol = sub.add_parser("policy", help="policy management")
     pol_sub = pol.add_subparsers(dest="policy_cmd", required=True)
@@ -771,6 +854,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("metrics", help="Prometheus metrics dump")
 
+    trp = sub.add_parser("trace",
+                         help="control-plane span traces "
+                              "(/debug/traces)")
+    trp.add_argument("--id", default="",
+                     help="show one trace's span tree")
+    trp.add_argument("--revision", type=int, default=None,
+                     help="show the span tree of a policy revision's "
+                          "propagation")
+    trp.add_argument("-n", type=int, default=50,
+                     help="trace summaries to list")
+    trp.add_argument("--json", action="store_true")
+
     ms = sub.add_parser("migrate-state",
                         help="upgrade endpoint checkpoints across "
                              "agent versions (cilium-map-migrate "
@@ -848,6 +943,7 @@ COMMANDS = {
     "prefilter": cmd_prefilter, "monitor": cmd_monitor,
     "hubble": cmd_hubble,
     "config": cmd_config, "metrics": cmd_metrics,
+    "trace": cmd_trace,
     "bugtool": cmd_bugtool, "cni": cmd_cni,
     "docker-plugin": cmd_docker_plugin,
     "debuginfo": cmd_debuginfo, "kvstore": cmd_kvstore,

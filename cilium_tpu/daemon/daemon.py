@@ -39,6 +39,8 @@ from ..l7.dns import DNSCache, DNSPoller, inject_to_cidr_set
 from ..labels import Labels
 from ..monitor import MonitorHub
 from ..node import Node, NodeManager, NodeRegistry
+from ..observability import (PolicyPropagationTracker, jit_telemetry,
+                             pipeline_report, tracer)
 from ..policy.api import Rule
 from ..policy.mapstate import PolicyMapState
 from ..policy.repository import Repository
@@ -47,8 +49,9 @@ from ..proxy import ProxyManager
 from ..migrate import MigrationError
 from ..utils.lock import RMutex
 from ..utils.controller import ControllerManager, ControllerParams
-from ..utils.metrics import (IDENTITY_COUNT, POLICY_COUNT,
-                             POLICY_IMPORT_ERRORS, POLICY_REVISION,
+from ..utils.metrics import (ENDPOINT_STATE_COUNT, IDENTITY_COUNT,
+                             POLICY_COUNT, POLICY_IMPORT_ERRORS,
+                             POLICY_REGENERATION_COUNT, POLICY_REVISION,
                              PROXY_REDIRECTS, registry as metrics_registry)
 from ..utils.option import DaemonConfig, parse_option_value
 from ..utils import resilience as transport_resilience
@@ -75,6 +78,18 @@ class Daemon:
                                   self.config.proxy_port_max)
         self.controllers = ControllerManager()
         self.datapath = Datapath(ct_slots=self.config.ct_slots)
+        # runtime self-telemetry (observability/): span tracing across
+        # the control plane, the policy-propagation latency tracker
+        # closed by the engine's revision-served hook, and the
+        # engine-side stage/jit/verdict accounting — one config switch
+        # gates all of it
+        tracer.configure(enabled=self.config.enable_tracing,
+                         capacity=self.config.trace_capacity)
+        self.tracer = tracer
+        self.propagation = PolicyPropagationTracker(tracer=tracer)
+        self.datapath.telemetry_enabled = self.config.enable_tracing
+        self.datapath.on_revision_served = \
+            self.propagation.revision_served
         # incremental policy realization: one endpoint's regeneration
         # writes one device-table row (syncPolicyMap analog); the
         # engine re-jits only when the stack's geometry grows
@@ -285,6 +300,7 @@ class Daemon:
         referenced prefixes (one ref per rule occurrence), insert into
         the repo, trigger regeneration.
         """
+        t_import = time.perf_counter()
         try:
             for r in rules:
                 r.sanitize()
@@ -314,6 +330,13 @@ class Daemon:
             rev = self.repo.add_list(list(rules))
         POLICY_COUNT.set(len(self.repo))
         POLICY_REVISION.set(rev)
+        # policy-propagation tracking: stamp the revision at import;
+        # the regeneration pipeline and the engine's revision-served
+        # hook fill in compile -> device-apply -> first-verdict, and
+        # the delay histogram closes on the last hop
+        self.propagation.revision_imported(
+            rev, rules=len(rules),
+            import_seconds=time.perf_counter() - t_import)
         self.monitor.notify_agent("policy-updated",
                                   f"revision={rev} rules={len(rules)}")
         self.trigger_policy_updates("policy-add")
@@ -442,9 +465,18 @@ class Daemon:
         """The per-endpoint build (endpoint/policy.go regenerate tail):
         resolve policy, allocate redirects, diff, swap device tables."""
         cache = IdentityCache.snapshot(self.identity_allocator)
-        res = ep.regenerate_policy(
-            self.repo, cache, proxy=self.proxy,
-            always_allow_localhost=self.config.always_allow_localhost())
+        # stage spans parent on the revision's import trace via
+        # explicit context — this runs on a build-worker thread, so
+        # thread-local propagation cannot carry it
+        with self.propagation.stage_span(
+                self.repo.revision, "policy.compile",
+                {"endpoint": ep.id}):
+            res = ep.regenerate_policy(
+                self.repo, cache, proxy=self.proxy,
+                always_allow_localhost=self.config
+                .always_allow_localhost())
+        self.propagation.revision_compiled(res.revision)
+        POLICY_REGENERATION_COUNT.inc()
         ep.apply_regeneration(res)
         PROXY_REDIRECTS.set(len(self.proxy))
         if self.host_path is not None:
@@ -455,8 +487,13 @@ class Daemon:
                 self.host_path.remove_endpoint(ep.id)
         # incremental device sync: this endpoint's row only
         # (endpoint/bpf.go:607 syncPolicyMap analog)
-        self.table_mgr.sync_endpoint(ep.id, ep.realized, res.revision)
-        self.datapath.refresh_policy(res.revision)
+        with self.propagation.stage_span(
+                res.revision, "policy.device-apply",
+                {"endpoint": ep.id}):
+            self.table_mgr.sync_endpoint(ep.id, ep.realized,
+                                         res.revision)
+            self.datapath.refresh_policy(res.revision)
+        self.propagation.revision_applied(res.revision)
         if self.config.state_dir:
             try:
                 ep.write_checkpoint(self.config.state_dir)
@@ -880,6 +917,17 @@ class Daemon:
             "transports": transport_resilience.status_summary(),
             "datapath": {"revision": self.datapath.revision,
                          "conntrack-slots": self.datapath.ct.slots},
+            # device-table fill fractions + threshold warnings
+            # (cilium_bpf_map_pressure analog); `cilium-tpu status
+            # --verbose` renders the same report
+            "map-pressure": self.datapath.map_pressure(
+                self.config.map_pressure_warn),
+            # runtime self-telemetry: tracer health, compile/jit-cache
+            # accounting, recent policy-propagation delays
+            "telemetry": {
+                "tracing": self.tracer.stats(),
+                "jit": jit_telemetry.report(),
+                "propagation": self.propagation.report(5)},
             # flow observability health (hubble observer + relay)
             "hubble": self.hubble.stats()
             if self.hubble is not None else None,
@@ -909,10 +957,45 @@ class Daemon:
         counts: Dict[str, int] = {}
         for ep in self.endpoints.endpoints():
             counts[ep.state] = counts.get(ep.state, 0) + 1
+        # keep the per-state gauge in lockstep, zeroing states no
+        # endpoint is in anymore (EndpointStateCount analog)
+        from ..endpoint import EndpointState as _ES
+        for state in (_ES.CREATING, _ES.WAITING_FOR_IDENTITY,
+                      _ES.READY, _ES.WAITING_TO_REGENERATE,
+                      _ES.REGENERATING, _ES.RESTORING,
+                      _ES.DISCONNECTING, _ES.DISCONNECTED,
+                      _ES.NOT_READY):
+            ENDPOINT_STATE_COUNT.set(counts.get(state, 0),
+                                     labels={"state": state})
         return counts
 
     def metrics_text(self) -> str:
+        # scrape-time collection: drain the deferred verdict-outcome
+        # accounting and refresh the map-pressure gauges (computed
+        # gauges, Prometheus collector semantics) so a bare /metrics
+        # scrape never under-reports or reads stale fill fractions
+        self.datapath.flush_telemetry()
+        self.datapath.map_pressure(self.config.map_pressure_warn)
         return metrics_registry.expose_text()
+
+    def pipeline_report(self) -> Dict:
+        """Host-timed pipeline stage breakdown (/debug/pipeline)."""
+        return pipeline_report()
+
+    def traces(self, trace_id: Optional[str] = None,
+               revision: Optional[int] = None, limit: int = 50):
+        """Span-trace surface (/debug/traces, `cilium-tpu trace`):
+        summaries by default, one span tree for an explicit trace id
+        or policy revision."""
+        if revision is not None:
+            trace_id = self.propagation.trace_id_of(revision)
+            if trace_id is None:
+                return None
+        if trace_id is not None:
+            return self.tracer.tree(trace_id)
+        return {"traces": self.tracer.traces(limit),
+                "tracer": self.tracer.stats(),
+                "propagation": self.propagation.report(limit)}
 
     # -------------------------------------------------- lifecycle
 

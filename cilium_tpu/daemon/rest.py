@@ -105,6 +105,24 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, d.policy_resolve(
                     frm, to, dports=body.get("dports"),
                     verbose=bool(body.get("verbose"))))
+            if path == "/debug/traces" and method == "GET":
+                # span-trace surface (observability/tracer.py):
+                # ?id=<trace> or ?revision=<rev> returns one span
+                # tree; bare GET lists recent trace summaries plus
+                # the propagation-latency report
+                tid = qs.get("id", [None])[0]
+                rev_q = qs.get("revision", [None])[0]
+                out = d.traces(
+                    trace_id=tid,
+                    revision=int(rev_q) if rev_q is not None else None,
+                    limit=int(qs.get("n", ["50"])[0]))
+                if out is None:
+                    return self._error(404, "trace not found")
+                return self._send(200, out)
+            if path == "/debug/pipeline" and method == "GET":
+                # host-timed stage slices + blocking boundaries
+                # (observability/stages.py pipeline_report)
+                return self._send(200, d.pipeline_report())
             if path == "/debuginfo" and method == "GET":
                 # cilium debuginfo (cilium/cmd/debuginfo.go): one
                 # aggregate snapshot for bug reports / support
@@ -134,6 +152,14 @@ class _Handler(BaseHTTPRequestHandler):
                         d.datapath.flow_snapshot(512),
                         "relay": d.hubble_relay.node_health()
                         if d.hubble_relay is not None else None},
+                    # runtime self-telemetry snapshot: recent traces,
+                    # propagation delays, pipeline stages, map
+                    # pressure — "what was the agent doing"
+                    "observability": {
+                        "traces": d.traces(),
+                        "pipeline": d.pipeline_report(),
+                        "map-pressure": d.datapath.map_pressure(
+                            d.config.map_pressure_warn)},
                 })
             m = re.fullmatch(r"/kvstore/(.+)", path)
             if m:

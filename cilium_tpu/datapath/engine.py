@@ -22,7 +22,11 @@ import numpy as np
 from ..compiler.lpm import (CompiledLPM, CompiledLPM6, compile_lpm,
                             compile_lpm6)
 from ..compiler.policy_tables import CompiledPolicy, compile_endpoints
+from ..observability.jitstats import jit_telemetry
+from ..observability.pressure import compute_pressure
+from ..observability.stages import record_stage
 from ..policy.mapstate import PolicyMapState
+from ..utils.metrics import POLICY_VERDICTS
 from .conntrack import ConntrackTable, make_ct_state
 from .lb import (CompiledLB, CompiledLB6, LoadBalancer, Service,
                  Service6, compile_lb, compile_lb6)
@@ -91,6 +95,15 @@ class Datapath:
         # when enabled, both family steps scatter per-flow counters
         # into this device table inside the same compiled program
         self.flows = None
+        # runtime self-telemetry (observability/): stage slices,
+        # jit-cache accounting, verdict-outcome counters, and the
+        # revision-served hook the policy-propagation tracker uses to
+        # close the import->first-verdict loop.  One flag gates all of
+        # it so the bench can prove the disabled path costs ~0.
+        self.telemetry_enabled = True
+        self.on_revision_served = None  # callable(revision)
+        self._served_revision = 0
+        self._pending_verdicts: List = []
 
     def enable_flow_aggregation(self, slots: int = 1 << 12,
                                 max_probe: int = 8,
@@ -321,6 +334,18 @@ class Datapath:
     def _rebuild(self, mgr_snapshot=None) -> None:
         if self._table_mgr is None and self.compiled_policy is None:
             return
+        t0 = time.perf_counter() if self.telemetry_enabled else 0.0
+        self._rebuild_body(mgr_snapshot)
+        if self.telemetry_enabled:
+            record_stage("engine", "table-build",
+                         time.perf_counter() - t0)
+            nbytes = 0
+            for tables in (self._tables, self._tables6):
+                for leaf in jax.tree_util.tree_leaves(tables):
+                    nbytes += int(getattr(leaf, "nbytes", 0))
+            jit_telemetry.set_device_bytes("engine-tables", nbytes)
+
+    def _rebuild_body(self, mgr_snapshot=None) -> None:
         if self.lb.compiled is None:
             self.lb._recompile()
         if self._table_mgr is not None:
@@ -440,9 +465,12 @@ class Datapath:
     def process(self, pkt: FullPacketBatch, now: Optional[int] = None):
         """Classify a batch. Returns (verdict, event, identity, nat) —
         nat carries the DNAT'd forward tuple and rev-NAT'd reply tuple."""
+        telem = self.telemetry_enabled
+        t0 = time.perf_counter() if telem else 0.0
         with self._lock:
             if self._step is None:
                 raise RuntimeError("no policy loaded")
+            t_lock = time.perf_counter() if telem else 0.0
             ts = jnp.int32(now if now is not None else int(time.time()))
             if self.flows is not None:
                 step = self._flow_step_variant(self._step,
@@ -452,18 +480,29 @@ class Datapath:
                     self._tables, self.ct.state, self.counters, pkt,
                     ts, self.flows.state)
             else:
+                step = self._step
                 (verdict, event, identity, nat,
-                 self.ct.state, self.counters) = self._step(
+                 self.ct.state, self.counters) = step(
                     self._tables, self.ct.state, self.counters, pkt, ts)
-            return verdict, event, identity, nat
+            if telem:
+                self._account_dispatch("engine-v4", "datapath.process",
+                                       step, pkt.endpoint.shape[0],
+                                       t0, t_lock, verdict)
+            served = self._revision_newly_served_locked()
+        if served:
+            self._notify_revision_served(served)
+        return verdict, event, identity, nat
 
     def process6(self, pkt: FullPacketBatch6,
                  now: Optional[int] = None):
         """Classify a v6 batch (bpf_lxc.c:745 ipv6_policy path).
         Returns (verdict, event, identity, nat6)."""
+        telem = self.telemetry_enabled
+        t0 = time.perf_counter() if telem else 0.0
         with self._lock:
             if self._step6 is None:
                 raise RuntimeError("no policy loaded")
+            t_lock = time.perf_counter() if telem else 0.0
             ts = jnp.int32(now if now is not None else int(time.time()))
             if self.flows is not None:
                 step = self._flow_step_variant(self._step6,
@@ -473,11 +512,97 @@ class Datapath:
                     self._tables6, self.ct6.state, self.counters, pkt,
                     ts, self.flows.state)
             else:
+                step = self._step6
                 (verdict, event, identity, nat,
-                 self.ct6.state, self.counters) = self._step6(
+                 self.ct6.state, self.counters) = step(
                     self._tables6, self.ct6.state, self.counters, pkt,
                     ts)
-            return verdict, event, identity, nat
+            if telem:
+                self._account_dispatch("engine-v6", "datapath.process6",
+                                       step, pkt.endpoint.shape[0],
+                                       t0, t_lock, verdict)
+            served = self._revision_newly_served_locked()
+        if served:
+            self._notify_revision_served(served)
+        return verdict, event, identity, nat
+
+    # -- self-telemetry (observability/) -------------------------------------
+
+    def _account_dispatch(self, family: str, entry: str, step,
+                          batch: int, t0: float, t_lock: float,
+                          verdict) -> None:
+        """Stage slices + jit-cache classification + deferred
+        verdict-outcome accounting for one dispatch (lock held)."""
+        t_done = time.perf_counter()
+        record_stage(family, "lock-wait", t_lock - t0)
+        record_stage(family, "dispatch", t_done - t_lock)
+        # a first call per (program, batch geometry) paid tracing +
+        # XLA compile synchronously inside the dispatch slice
+        jit_telemetry.record(entry, id(step), int(batch),
+                             t_done - t_lock)
+        self._pending_verdicts.append(verdict)
+        self._flush_verdict_counts(
+            force=len(self._pending_verdicts) > 8)
+
+    def _flush_verdict_counts(self, force: bool = False) -> None:
+        """Count verdict outcomes from completed batches (lock held).
+        Dispatch is async, so the just-dispatched batch is usually not
+        ready — it gets counted on a later call (or force-synced once
+        the pending window fills), never blocking the hot path."""
+        remaining = []
+        for arr in self._pending_verdicts:
+            ready = force
+            if not ready:
+                checker = getattr(arr, "is_ready", None)
+                try:
+                    ready = checker() if checker is not None else True
+                except Exception:  # noqa: BLE001 — deleted/donated
+                    continue
+            if not ready:
+                remaining.append(arr)
+                continue
+            try:
+                v = np.asarray(arr)
+            except Exception:  # noqa: BLE001 — deleted buffer
+                continue
+            denied = int((v < 0).sum())
+            redirected = int((v > 0).sum())
+            allowed = v.shape[0] - denied - redirected
+            if allowed:
+                POLICY_VERDICTS.inc(allowed,
+                                    labels={"outcome": "allowed"})
+            if denied:
+                POLICY_VERDICTS.inc(denied,
+                                    labels={"outcome": "denied"})
+            if redirected:
+                POLICY_VERDICTS.inc(redirected,
+                                    labels={"outcome": "redirected"})
+        self._pending_verdicts = remaining
+
+    def flush_telemetry(self) -> None:
+        """Drain deferred verdict accounting (metrics-scrape path)."""
+        with self._lock:
+            self._flush_verdict_counts(force=True)
+
+    def _revision_newly_served_locked(self) -> int:
+        """First dispatch at a new policy revision (lock held).
+        Returns the revision to report, or 0."""
+        if self.on_revision_served is None or \
+                self.revision <= self._served_revision:
+            return 0
+        self._served_revision = self.revision
+        return self.revision
+
+    def _notify_revision_served(self, revision: int) -> None:
+        try:
+            self.on_revision_served(revision)
+        except Exception:  # noqa: BLE001 — telemetry must never
+            pass           # poison the verdict path
+
+    def map_pressure(self, warn_threshold: float = 0.9) -> Dict:
+        """Map-pressure report over the live device tables (updates
+        the map_pressure/map_entries gauges as a side effect)."""
+        return compute_pressure(self.map_inventory(), warn_threshold)
 
     def lb6_service_list(self):
         """Snapshot of the v6 service registry under the engine lock —
@@ -523,7 +648,9 @@ class Datapath:
                 geom, _t = self._table_mgr.snapshot()
                 cap, slots, probe, gen = geom
                 out["policy"] = {"endpoints": cap, "slots": slots,
-                                 "max-probe": probe, "generation": gen}
+                                 "max-probe": probe, "generation": gen,
+                                 "attached":
+                                 self._table_mgr.stats()["endpoints"]}
             elif self.compiled_policy is not None:
                 out["policy"] = {
                     "endpoints": self.compiled_policy.num_endpoints,

@@ -22,6 +22,8 @@ import numpy as np
 
 from ..compiler.hashtab import HashTable, _next_pow2, build_hash_table
 from ..compiler.policy_tables import pack_key
+from ..observability.jitstats import jit_telemetry
+from ..observability.stages import record_stage
 from ..policy.mapstate import PolicyMapState
 
 MIN_SLOTS = 64
@@ -125,6 +127,8 @@ class DeviceTableManager:
         "entries": N, "generation": G}. Raises KeyError for an
         unattached endpoint.
         """
+        import time as _time
+        t0 = _time.perf_counter()
         with self._lock:
             slot = self._slot_of[endpoint_id]
             full_swap = False
@@ -144,9 +148,18 @@ class DeviceTableManager:
                 self._write_row(slot, table.key_a, table.key_b,
                                 table.value, probe=table.max_probe)
             self.revision = max(self.revision, revision)
-            return {"full_swap": full_swap, "slots": self.slots,
-                    "entries": len(state), "generation": self.generation,
-                    "max_probe": self.max_probe}
+            out = {"full_swap": full_swap, "slots": self.slots,
+                   "entries": len(state),
+                   "generation": self.generation,
+                   "max_probe": self.max_probe}
+            nbytes = int(self._h_key_id.nbytes * 3)
+        # device-apply telemetry (observability/): the row sync IS the
+        # syncPolicyMap hot path, the full swap its slow fallback
+        record_stage("device-tables",
+                     "full-swap" if full_swap else "row-sync",
+                     _time.perf_counter() - t0)
+        jit_telemetry.set_device_bytes("policy-tables", nbytes)
+        return out
 
     def _write_row(self, slot: int, key_a: np.ndarray, key_b: np.ndarray,
                    value: np.ndarray, probe: int) -> None:

@@ -136,6 +136,12 @@ class HubbleRelay:
         deadline = Deadline(budget)
         with self._mu:
             peers = list(self._peers.values())
+        # observability: the fan-out joins the caller's trace (or
+        # roots a new one) so `cilium-tpu trace` shows the relay leg
+        from ..observability.tracer import tracer
+        span = tracer.span("relay.get_flows",
+                           attrs={"peers": len(peers),
+                                  "deadline-s": budget})
 
         results: Dict[str, Dict] = {}
         threads = []
@@ -209,6 +215,9 @@ class HubbleRelay:
         if limit:
             flows = flows[-limit:]
         self._export_gauge()
+        span.set_attr("flows", len(flows))
+        span.set_attr("partial", partial)
+        span.finish()
         return {"flows": flows, "nodes": node_status, "partial": partial}
 
     def node_health(self) -> List[Dict]:

@@ -22,6 +22,8 @@ import time
 import uuid
 from typing import Dict, Optional
 
+from ..observability.tracer import tracer
+from ..utils.metrics import KVSTORE_OPERATIONS
 from ..utils.netio import teardown_http_conn
 from ..utils.resilience import (SYNTHETIC_EVENTS, TRANSPORT_DEADLINES,
                                 TRANSPORT_RETRIES, TRANSPORT_VERIFIES,
@@ -112,6 +114,18 @@ class EtcdBackend(BackendOperations):
         payload = json.dumps(body).encode()
         idempotent = path not in _NON_IDEMPOTENT_PATHS
         deadline = Deadline(self.timeout)
+        # op-kind accounting (cilium_kvstore_operations_total analog)
+        # + a child span when the caller is inside an active trace
+        # (daemon -> kvstore context propagation)
+        op_kind = path[len("/v3/"):].replace("/", "-")
+        KVSTORE_OPERATIONS.inc(labels={"backend": "etcd",
+                                       "op": op_kind})
+        with tracer.child_span(f"etcd.{op_kind}"):
+            return self._call_locked(path, payload, idempotent,
+                                     deadline)
+
+    def _call_locked(self, path: str, payload: bytes,
+                     idempotent: bool, deadline: Deadline) -> Dict:
         attempt = 0
         with self._conn_mu:
             while True:
@@ -310,6 +324,8 @@ class EtcdBackend(BackendOperations):
                     "key": _b64e(prefix),
                     "range_end": _b64e(_prefix_range_end(prefix)),
                     "start_revision": str(cursor)}}).encode()
+                KVSTORE_OPERATIONS.inc(labels={"backend": "etcd",
+                                               "op": "watch"})
                 conn.request("POST", "/v3/watch", body=payload,
                              headers={"Content-Type":
                                       "application/json"})

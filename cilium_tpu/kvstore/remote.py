@@ -15,6 +15,8 @@ import socket
 import threading
 from typing import Dict, Optional
 
+from ..observability.tracer import tracer
+from ..utils.metrics import KVSTORE_OPERATIONS
 from ..utils.resilience import (TRANSPORT_RETRIES, TRANSPORT_VERIFIES,
                                 Deadline)
 from .backend import (EVENT_LIST_DONE, BackendOperations, Event,
@@ -133,18 +135,24 @@ class RemoteBackend(BackendOperations):
         send — their callers verify on RemoteTimeout."""
         if _timeout is None:
             _timeout = self.call_timeout
-        if op not in _IDEMPOTENT_OPS:
-            return self._call_once(op, _timeout, args)
-        deadline = Deadline(_timeout)
-        try:
-            return self._call_once(op, max(0.05, _timeout / 2.0), args)
-        except RemoteTimeout:
-            if self._closed.is_set():
-                raise
-            TRANSPORT_RETRIES.inc(
-                labels={"transport": "remote", "op": op})
-            return self._call_once(op, max(0.05, deadline.remaining()),
-                                   args)
+        # op-kind accounting (cilium_kvstore_operations_total analog)
+        # + a child span when inside an active trace (daemon ->
+        # kvstore context propagation)
+        KVSTORE_OPERATIONS.inc(labels={"backend": "remote", "op": op})
+        with tracer.child_span(f"kvstore.{op}"):
+            if op not in _IDEMPOTENT_OPS:
+                return self._call_once(op, _timeout, args)
+            deadline = Deadline(_timeout)
+            try:
+                return self._call_once(op, max(0.05, _timeout / 2.0),
+                                       args)
+            except RemoteTimeout:
+                if self._closed.is_set():
+                    raise
+                TRANSPORT_RETRIES.inc(
+                    labels={"transport": "remote", "op": op})
+                return self._call_once(
+                    op, max(0.05, deadline.remaining()), args)
 
     def _call_once(self, op: str, timeout: float, args: dict) -> dict:
         if self._closed.is_set():

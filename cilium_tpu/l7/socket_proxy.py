@@ -33,6 +33,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils.metrics import PROXY_UPSTREAM_TIME
 from .http import HTTPRequest
 from .kafka import (KafkaParseError, KafkaRequest, parse_kafka_request)
 from .parser import Connection as ParserConnection
@@ -451,14 +452,18 @@ class SocketProxy:
                     (corr,) = struct.unpack_from(">i", frame, 4)
                     entry = correlation.correlate(corr)
                     if entry is not None:
+                        latency = time.time() - entry.sent_at
+                        # upstream reply time (cilium_proxy_upstream_
+                        # reply_seconds analog), correlated exactly
+                        PROXY_UPSTREAM_TIME.observe(
+                            latency, labels={"protocol": "kafka"})
                         self._log(ctx, "response", "kafka", dst_id,
                                   src_id,
                                   {"correlation_id": corr,
                                    "api_key": entry.api_key,
                                    "topics": entry.topics,
                                    "latency_ms": round(
-                                       (time.time() - entry.sent_at)
-                                       * 1000, 2)})
+                                       latency * 1000, 2)})
                 client_w.write(frame)
                 await client_w.drain()
             try:
@@ -489,6 +494,13 @@ class SocketProxy:
         batcher = self._http_batcher(engine) \
             if (self.http_batch_window > 0 and engine is not None) \
             else None
+        # forwarded-request timestamps, consumed by the reply path's
+        # status-line sampler: HTTP/1.1 responses arrive in request
+        # order on one connection, so a FIFO correlates them for the
+        # upstream-reply-time histogram (%DURATION% analog).  Both
+        # coroutines run on the same loop — no locking needed.
+        from collections import deque as _deque
+        sent_at: "_deque[float]" = _deque(maxlen=256)
 
         async def request_path():
             buf = b""
@@ -565,6 +577,7 @@ class SocketProxy:
                     up_w.write(raw_head)
                     buf = await _forward_chunked(client_r, buf, up_w)
                     await up_w.drain()
+                    sent_at.append(time.perf_counter())
                 else:
                     body_len = _content_length(headers)
                     while len(buf) < body_len:
@@ -575,6 +588,7 @@ class SocketProxy:
                     body, buf = buf[:body_len], buf[body_len:]
                     up_w.write(raw_head + body)
                     await up_w.drain()
+                    sent_at.append(time.perf_counter())
                 self._log(ctx, "forwarded", "http", src_id, dst_id,
                           info)
             try:
@@ -600,6 +614,13 @@ class SocketProxy:
                     if nl >= 0:
                         status = parse_status_line(head_buf[:nl])
                         if status is not None:
+                            if sent_at:
+                                # upstream reply time: forwarded
+                                # request -> its status line
+                                PROXY_UPSTREAM_TIME.observe(
+                                    time.perf_counter() -
+                                    sent_at.popleft(),
+                                    labels={"protocol": "http"})
                             self._log(ctx, "response", "http", dst_id,
                                       src_id, {"status": status})
                         head_buf = b""

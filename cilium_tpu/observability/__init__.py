@@ -1,0 +1,41 @@
+"""Runtime self-telemetry: the system watching itself.
+
+Hubble (hubble/) made the *traffic* observable; this package makes the
+*agent* observable — the TPU analog of the reference's
+pkg/metrics/metrics.go policy-revision and map-pressure series plus a
+lightweight span tracer for control-plane causality:
+
+- ``tracer``       — bounded in-memory span tracing with explicit
+                     context propagation (daemon -> kvstore ->
+                     verdict_service/relay), served at /debug/traces
+                     and ``cilium-tpu trace``.
+- ``propagation``  — policy-propagation latency: every repository
+                     revision's journey import -> compile -> device
+                     apply -> first verdict, as the
+                     ``policy_implementation_delay_seconds`` histogram
+                     plus a per-revision span tree.
+- ``jitstats``     — JIT/compile telemetry (compile count/seconds,
+                     jit-cache hit/miss, live device bytes) captured
+                     around every jitted entry point.
+- ``stages``       — host-timed pipeline stage slices and blocking
+                     boundaries, exported as histograms and
+                     ``pipeline_report()``.
+- ``pressure``     — map-pressure gauges + warning thresholds for
+                     every device table (pkg/metrics BPFMapPressure
+                     analog).
+"""
+
+from .tracer import Span, SpanContext, Tracer, tracer
+from .propagation import (POLICY_IMPLEMENTATION_DELAY,
+                          PolicyPropagationTracker)
+from .jitstats import JitTelemetry, jit_telemetry
+from .stages import PIPELINE_STAGE_SECONDS, pipeline_report, record_stage
+from .pressure import MAP_PRESSURE, compute_pressure
+
+__all__ = [
+    "Span", "SpanContext", "Tracer", "tracer",
+    "POLICY_IMPLEMENTATION_DELAY", "PolicyPropagationTracker",
+    "JitTelemetry", "jit_telemetry",
+    "PIPELINE_STAGE_SECONDS", "pipeline_report", "record_stage",
+    "MAP_PRESSURE", "compute_pressure",
+]

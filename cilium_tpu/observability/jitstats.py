@@ -1,0 +1,118 @@
+"""JIT/compile telemetry around every jitted entry point.
+
+XLA compiles lazily: ``jax.jit`` returns instantly and the first call
+per (program, input geometry) pays tracing + compilation synchronously
+before dispatch.  The engine rebuilds its jitted steps on every
+geometry change, so "how much wall time does this agent spend
+compiling, and how often does a batch hit a cold program?" is a real
+operational question (the Taurus lesson: stage-level timing must be
+built into the pipeline, not bolted on).
+
+``JitTelemetry.record(entry, key, seconds)`` classifies each timed
+dispatch: an unseen (program instance, shape key) is a jit-cache MISS
+whose wall time is dominated by compilation (counted + histogrammed);
+a seen one is a HIT whose wall time is pure dispatch.  Live device
+bytes are a gauge fed by the table owners (engine rebuilds, the
+DeviceTableManager).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Set, Tuple
+
+from ..utils.metrics import registry
+
+COMPILE_COUNT = registry.counter(
+    "jit_compile_total",
+    "Jitted-program compilations (first call per program x geometry) "
+    "by entry point")
+COMPILE_SECONDS = registry.histogram(
+    "jit_compile_seconds",
+    "Wall time of compiling dispatches (trace + XLA compile + first "
+    "run) by entry point",
+    buckets=(.01, .05, .1, .25, .5, 1, 2.5, 5, 10, 30, 60, 120))
+JIT_CACHE_EVENTS = registry.counter(
+    "jit_cache_events_total",
+    "Jit-cache hits and misses across all jitted entry points")
+DEVICE_BYTES = registry.gauge(
+    "device_table_bytes",
+    "Live device-resident table bytes by owner")
+
+
+class JitTelemetry:
+    """Process-wide compile/cache accounting (cheap: one set lookup
+    and two counter bumps per dispatch when enabled)."""
+
+    def __init__(self):
+        self.enabled = True
+        self._lock = threading.Lock()
+        self._seen: Set[Tuple[str, int, object]] = set()
+        self._compiles: Dict[str, int] = {}
+        self._compile_seconds: Dict[str, float] = {}
+        self._hits = 0
+        self._misses = 0
+
+    def record(self, entry: str, instance: int, key,
+               seconds: float) -> bool:
+        """Account one timed dispatch of jitted ``entry``.
+        ``instance`` identifies the program object (id of the jitted
+        callable — a rebuild makes a new one), ``key`` its input
+        geometry (batch size).  Returns True when classified as a
+        compile (miss)."""
+        if not self.enabled:
+            return False
+        tag = (entry, instance, key)
+        with self._lock:
+            miss = tag not in self._seen
+            if miss:
+                self._seen.add(tag)
+                self._misses += 1
+                self._compiles[entry] = self._compiles.get(entry, 0) + 1
+                self._compile_seconds[entry] = \
+                    self._compile_seconds.get(entry, 0.0) + seconds
+                # the seen-set grows one tag per real XLA compile;
+                # bound it anyway so a pathological shape churn can't
+                # leak (matches XLA's own cache eviction in spirit)
+                if len(self._seen) > 65536:
+                    self._seen.clear()
+                    self._seen.add(tag)
+            else:
+                self._hits += 1
+        if miss:
+            COMPILE_COUNT.inc(labels={"entry": entry})
+            COMPILE_SECONDS.observe(seconds, labels={"entry": entry})
+            JIT_CACHE_EVENTS.inc(labels={"event": "miss"})
+        else:
+            JIT_CACHE_EVENTS.inc(labels={"event": "hit"})
+        return miss
+
+    def set_device_bytes(self, owner: str, nbytes: int) -> None:
+        if self.enabled:
+            DEVICE_BYTES.set(float(nbytes), labels={"owner": owner})
+
+    def report(self) -> Dict:
+        with self._lock:
+            out = {
+                "compiles": dict(self._compiles),
+                "compile-seconds": {k: round(v, 6) for k, v in
+                                    self._compile_seconds.items()},
+                "cache-hits": self._hits,
+                "cache-misses": self._misses,
+            }
+        with DEVICE_BYTES._lock:
+            per_owner = {"/".join(v for _k, v in key): val
+                         for key, val in DEVICE_BYTES._values.items()}
+        out["device-bytes"] = per_owner
+        out["device-bytes-total"] = sum(per_owner.values())
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._seen.clear()
+            self._compiles.clear()
+            self._compile_seconds.clear()
+            self._hits = self._misses = 0
+
+
+jit_telemetry = JitTelemetry()

@@ -1,0 +1,93 @@
+"""Map-pressure gauges for every device table.
+
+Reference: pkg/metrics BPFMapPressure (cilium_bpf_map_pressure) — the
+fill fraction of every fixed-capacity BPF map, the "which table is
+about to overflow" early warning.  Here the fixed-capacity tables are
+the device-resident ones: conntrack (v4/v6), the stacked policy rows,
+and the Hubble flow-aggregation table.  Host-compiled lookup tables
+(ipcache, LB, tunnel, prefilter) rebuild at any size, so they report
+entry counts without a pressure fraction.
+
+``compute_pressure`` consumes the engine's existing geometry/occupancy
+report (``Datapath.map_inventory``), updates the gauges, and returns
+the structured report with warnings above the configured threshold —
+surfaced in ``daemon.status()``, ``cilium-tpu status --verbose``,
+bugtool, and debuginfo.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..utils.metrics import registry
+
+MAP_PRESSURE = registry.gauge(
+    "map_pressure",
+    "Fill fraction (0..1) of fixed-capacity device tables by map")
+MAP_ENTRIES = registry.gauge(
+    "map_entries",
+    "Live entries per device table by map")
+
+DEFAULT_WARN_THRESHOLD = 0.9
+
+
+def _bounded(occupied: int, capacity: int) -> float:
+    if capacity <= 0:
+        return 0.0
+    return round(occupied / capacity, 6)
+
+
+def compute_pressure(inventory: Dict[str, Dict],
+                     warn_threshold: float = DEFAULT_WARN_THRESHOLD
+                     ) -> Dict:
+    """Pressure report from a ``map_inventory()`` dict.  Updates the
+    gauges as a side effect (the /metrics view and this report can
+    never disagree)."""
+    maps: Dict[str, Dict] = {}
+    warnings: List[str] = []
+
+    def add(name: str, occupied: int, capacity: int) -> None:
+        p = _bounded(occupied, capacity)
+        maps[name] = {"occupied": occupied, "capacity": capacity,
+                      "pressure": p}
+        MAP_PRESSURE.set(p, labels={"map": name})
+        MAP_ENTRIES.set(float(occupied), labels={"map": name})
+        if capacity > 0 and p >= warn_threshold:
+            warnings.append(
+                f"{name}: {occupied}/{capacity} "
+                f"({p * 100:.1f}% >= {warn_threshold * 100:.0f}%)")
+
+    for name in ("ct", "ct6"):
+        entry = inventory.get(name)
+        if entry:
+            add(name, int(entry.get("occupied", 0)),
+                int(entry.get("slots", 0)))
+    pol = inventory.get("policy")
+    if pol:
+        if "endpoints" in pol and "slots" in pol:
+            # stacked [endpoints x slots] rows; row occupancy is
+            # endpoint count vs row capacity (the grow trigger), slot
+            # fill within a row is bounded by the manager's max_load
+            occupied = int(pol.get("attached", pol.get("entries", 0)))
+            add("policy-rows", occupied, int(pol["endpoints"]))
+    flows = inventory.get("hubble-flows")
+    if flows:
+        add("hubble-flows", int(flows.get("occupied", 0)),
+            int(flows.get("slots", 0)))
+    # unbounded (host-rebuilt) tables: entries only, no pressure
+    for name in ("ipcache", "ipcache6", "tunnel"):
+        entry = inventory.get(name)
+        if entry is not None:
+            n = int(entry.get("entries", 0))
+            maps[name] = {"occupied": n, "capacity": None,
+                          "pressure": None}
+            MAP_ENTRIES.set(float(n), labels={"map": name})
+    for name, key in (("lb", "services"), ("lb6", "services")):
+        entry = inventory.get(name)
+        if entry is not None:
+            n = int(entry.get(key, 0))
+            maps[name] = {"occupied": n, "capacity": None,
+                          "pressure": None}
+            MAP_ENTRIES.set(float(n), labels={"map": name})
+    return {"maps": maps, "warnings": warnings,
+            "warn-threshold": warn_threshold}

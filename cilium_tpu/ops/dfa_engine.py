@@ -45,6 +45,7 @@ the oracle.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -295,14 +296,26 @@ class DFAEngine:
         if isinstance(data, PackedBatch):
             return self.match_encoded(data)
         data = jnp.asarray(data)
+        # jit-cache telemetry (observability/jitstats): the dispatch
+        # slice is timed and the first call per (engine, strategy,
+        # geometry) is classified as a compile
+        from ..observability.jitstats import jit_telemetry
+        t0 = time.perf_counter() if jit_telemetry.enabled else 0.0
         if self.strategy == "stride":
-            return _stride_match(self.k, self._c1, self._flat, self._map,
-                                 self._accept, self._starts, data)
-        if self.strategy == "compose":
-            return dfa_match_compose(self._table_q, self._accept,
-                                     self._starts, data, self.k)
-        return _assoc_match(self._table_q, self._accept, self._starts,
-                            data)
+            out = _stride_match(self.k, self._c1, self._flat,
+                                self._map, self._accept, self._starts,
+                                data)
+        elif self.strategy == "compose":
+            out = dfa_match_compose(self._table_q, self._accept,
+                                    self._starts, data, self.k)
+        else:
+            out = _assoc_match(self._table_q, self._accept,
+                               self._starts, data)
+        if jit_telemetry.enabled:
+            jit_telemetry.record(
+                f"dfa.match-{self.strategy}", id(self),
+                tuple(data.shape), time.perf_counter() - t0)
+        return out
 
     def match_encoded(self, packed: PackedBatch) -> jnp.ndarray:
         """Device half of the split dispatch (see :meth:`encode`)."""

@@ -137,21 +137,42 @@ class Histogram(_Metric):
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        with self._lock:
+            return self._totals.get(_lk(labels), 0)
+
+    def total_count(self) -> int:
+        """Observations across every label combination."""
+        with self._lock:
+            return sum(self._totals.values())
+
+    def sum_value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._sums.get(_lk(labels), 0.0)
+
     def expose(self) -> List[str]:
         out = []
         with self._lock:
-            for key, counts in sorted(self._counts.items()):
+            # a declared histogram with zero observations must still
+            # expose its full series (buckets, +Inf, _sum 0, _count 0)
+            # — Counter/Gauge emit `name 0`, and conformance scrapers
+            # expect every declared series to exist (the reference's
+            # promhttp does the same for registered collectors)
+            items = sorted(self._counts.items()) or \
+                [(_lk(None), [0] * len(self.buckets))]
+            for key, counts in items:
                 for ub, c in zip(self.buckets, counts):
                     lk = key + (("le", repr(ub)),)
                     out.append(f"{self.name}_bucket{_fmt_labels(lk)} {c}")
+                total = self._totals.get(key, 0)
                 inf = key + (("le", "+Inf"),)
                 out.append(
                     f"{self.name}_bucket{_fmt_labels(inf)} "
-                    f"{self._totals[key]}")
+                    f"{total}")
                 out.append(f"{self.name}_sum{_fmt_labels(key)} "
-                           f"{self._sums[key]}")
+                           f"{self._sums.get(key, 0.0)}")
                 out.append(f"{self.name}_count{_fmt_labels(key)} "
-                           f"{self._totals[key]}")
+                           f"{total}")
         return out
 
 

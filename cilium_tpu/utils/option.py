@@ -227,6 +227,15 @@ class DaemonConfig:
     hubble_flow_probe: int = 8
     # relay fan-out deadline (a dead peer costs at most this per query)
     hubble_relay_deadline_s: float = 2.0
+    # runtime self-telemetry (observability/): span tracing +
+    # stage/jit/verdict accounting.  Disabling drops the datapath's
+    # telemetry cost to ~0 (the tracing-overhead bench's off leg).
+    enable_tracing: bool = True
+    trace_capacity: int = 4096
+    # map-pressure warning threshold (pkg/metrics BPFMapPressure
+    # analog): tables at or above this fill fraction surface warnings
+    # in status() / `cilium-tpu status --verbose`
+    map_pressure_warn: float = 0.9
     kvstore: str = "memory"
     kvstore_opts: Dict[str, str] = field(default_factory=dict)
     # runtime-mutable option map shared by new endpoints

@@ -280,8 +280,14 @@ class VerdictService:
     def _classify(self, soa, n: int) -> Tuple[np.ndarray, np.ndarray]:
         """One device dispatch for n drained records (padded to a
         power-of-two bucket; pad rows duplicate row 0 so no new
-        conntrack keys appear)."""
+        conntrack keys appear).  Each host stage (pack, dispatch,
+        device sync) is timed into the pipeline-stage histograms and
+        the batch runs under a tracer span — the verdict-service leg
+        of the daemon -> TPU trace (~0 cost when telemetry is off)."""
         from .datapath.engine import make_full_batch
+        from .observability.stages import record_stage
+        from .observability.tracer import tracer
+        telem = getattr(self.datapath, "telemetry_enabled", False)
         rows = _bucket(n)
 
         def pad(a):
@@ -290,6 +296,10 @@ class VerdictService:
             out[n:] = a[0]
             return out
 
+        span = tracer.span("verdict-service.classify",
+                           attrs={"records": n, "rows": rows}) \
+            if telem else None
+        t0 = time.perf_counter()
         batch = make_full_batch(
             endpoint=pad(soa["endpoint"]), saddr=pad(soa["saddr"]),
             daddr=pad(soa["daddr"]), sport=pad(soa["sport"]),
@@ -298,11 +308,23 @@ class VerdictService:
             tcp_flags=pad(soa["tcp_flags"]),
             is_fragment=pad(soa["is_fragment"]),
             length=pad(soa["length"]))
+        t_pack = time.perf_counter()
         verdict, _event, identity, _nat = self.datapath.process(batch)
+        t_dispatch = time.perf_counter()
         with self._stats_lock:
             self.batches_dispatched += 1
-        return (np.asarray(verdict)[:n].astype(np.int32),
-                np.asarray(identity)[:n].astype(np.int32))
+        out = (np.asarray(verdict)[:n].astype(np.int32),
+               np.asarray(identity)[:n].astype(np.int32))
+        if telem:
+            t_sync = time.perf_counter()
+            record_stage("verdict-service", "pack", t_pack - t0)
+            record_stage("verdict-service", "dispatch",
+                         t_dispatch - t_pack)
+            # the blocking boundary: host waits out device compute
+            record_stage("verdict-service", "sync",
+                         t_sync - t_dispatch)
+            span.finish()
+        return out
 
     # --------------------------------------------------------- lifecycle
 

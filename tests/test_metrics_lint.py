@@ -1,0 +1,78 @@
+"""Metric-surface lint: documented by construction.
+
+A static pass over the process metrics registry (every module that
+registers series is imported first) that fails when:
+
+- any metric is registered without help text, or
+- any registered metric is missing from the README's
+  "Metric inventory" table, or
+- the README inventory names a metric that no longer exists (stale
+  docs are as misleading as missing ones).
+
+This keeps the /metrics surface and its documentation in lockstep —
+adding a series without documenting it is a test failure, not a
+review nit.
+"""
+
+import os
+import re
+
+# import every module that registers metrics (the registry is
+# process-global; registration happens at import time)
+import cilium_tpu.utils.metrics as metrics_mod
+import cilium_tpu.utils.resilience  # noqa: F401
+import cilium_tpu.observability  # noqa: F401
+
+README = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "README.md")
+
+
+def _registered():
+    with metrics_mod.registry._lock:
+        return dict(metrics_mod.registry._metrics)
+
+
+def _readme_inventory():
+    """Metric names from the README inventory table (first backticked
+    column of rows inside the 'Metric inventory' section)."""
+    with open(README) as f:
+        text = f.read()
+    section = text.split("### Metric inventory", 1)
+    assert len(section) == 2, "README lost its Metric inventory section"
+    names = set()
+    for line in section[1].splitlines():
+        m = re.match(r"\|\s*`(cilium_tpu_[a-z0-9_]+)`\s*\|", line)
+        if m:
+            names.add(m.group(1))
+        elif line.startswith("## "):
+            break  # next top-level section
+    assert names, "Metric inventory table is empty"
+    return names
+
+
+def test_every_metric_has_help_text():
+    missing = [name for name, m in _registered().items() if not m.help]
+    assert not missing, \
+        f"metrics registered without help text: {sorted(missing)}"
+
+
+def test_every_metric_documented_in_readme():
+    documented = _readme_inventory()
+    undocumented = sorted(set(_registered()) - documented)
+    assert not undocumented, (
+        "metrics missing from the README 'Metric inventory' table "
+        f"(add a row per metric): {undocumented}")
+
+
+def test_readme_inventory_is_not_stale():
+    documented = _readme_inventory()
+    stale = sorted(documented - set(_registered()))
+    assert not stale, (
+        "README 'Metric inventory' documents metrics that are no "
+        f"longer registered: {stale}")
+
+
+def test_registry_names_are_prometheus_legal():
+    bad = [n for n in _registered()
+           if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", n)]
+    assert not bad, f"illegal metric names: {bad}"
