@@ -94,7 +94,8 @@ def _smoke_result():
                     ("fqdn", 15_600_000), ("capacity", 14_000_000),
                     ("incremental", 363),
                     ("flows-overhead", 1_200_000),
-                    ("tracing-overhead", 1_250_000)):
+                    ("tracing-overhead", 1_250_000),
+                    ("provenance-overhead", 1_250_000)):
         suite[name] = {"metric": name, "value": v, "unit": "x/s",
                        "vs_baseline": round(v / 1e7, 3),
                        "extra": {"batch": 8192, "smoke": True,
@@ -324,7 +325,7 @@ def run_bench():
         import bench_suite
         for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
                      "capacity", "incremental", "flows-overhead",
-                     "tracing-overhead"):
+                     "tracing-overhead", "provenance-overhead"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"
                 continue

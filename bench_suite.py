@@ -603,6 +603,88 @@ def bench_tracing_overhead(on_accel: bool):
                       for k, v in times.items()}})
 
 
+def bench_provenance_overhead(on_accel: bool):
+    """Verdict-provenance cost proof: v4 full-pipeline verdict
+    throughput with per-packet matched-rule + decision-tier emission
+    fused in vs disabled.  Same real path both ways — Datapath.process
+    over the 1000-rule config-1 policy, telemetry off on both legs so
+    the static provenance flag is the ONLY difference (disabled = the
+    exact pre-provenance compiled program).  Interleaved min-of-rounds
+    like the flows/tracing benches.  Acceptance bar: <=2.5% verdict-
+    throughput overhead enabled; disabled leg unchanged."""
+    from bench import build_config1
+    from cilium_tpu.datapath.engine import Datapath, make_full_batch
+
+    states, prefixes = build_config1(n_rules=1000, n_endpoints=64)
+    batch = (1 << 20) if on_accel else (1 << 16)
+    rng = np.random.default_rng(17)
+    n_endpoints = len(states)
+
+    def make_dp(provenance: bool) -> Datapath:
+        dp = Datapath(ct_slots=1 << 16)
+        dp.telemetry_enabled = False
+        if provenance:
+            dp.enable_provenance()
+        dp.load_policy(states, revision=1, ipcache_prefixes=prefixes)
+        for slot in range(n_endpoints):
+            dp.set_endpoint_identity(slot, 1000 + slot)
+        return dp
+
+    n_active_flows = 8192
+    sel = rng.integers(0, n_active_flows, batch)
+    pool = {
+        "endpoint": rng.integers(0, n_endpoints, n_active_flows),
+        "saddr": rng.integers(0, 1 << 32, n_active_flows,
+                              dtype=np.uint32),
+        "daddr": rng.integers(0, 1 << 32, n_active_flows,
+                              dtype=np.uint32),
+        "sport": rng.integers(1024, 65535, n_active_flows),
+        "dport": rng.integers(1, 65536, n_active_flows),
+    }
+    pkt = make_full_batch(
+        endpoint=pool["endpoint"][sel], saddr=pool["saddr"][sel],
+        daddr=pool["daddr"][sel], sport=pool["sport"][sel],
+        dport=pool["dport"][sel], length=np.full(batch, 256))
+
+    datapaths = {}
+    clocks = {}
+    for label, provenance in (("disabled", False), ("enabled", True)):
+        dp = make_dp(provenance)
+        clocks[label] = 1000
+        for _ in range(8):  # settle CT entries + first compiles
+            clocks[label] += 1
+            dp.process(pkt, now=clocks[label])
+        datapaths[label] = dp
+
+    iters = 8
+    rounds = 5
+    times = {"disabled": [], "enabled": []}
+    for _ in range(rounds):
+        for label, dp in datapaths.items():
+            def step():
+                clocks[label] += 1
+                v, _e, _i, _n = dp.process(pkt, now=clocks[label])
+                v.block_until_ready()
+            total, _p99 = _bench(step, iters, warmup=1)
+            times[label].append(total / iters)
+
+    base_s = float(np.min(times["disabled"]))
+    prov_s = float(np.min(times["enabled"]))
+    base = batch / base_s
+    prov = batch / prov_s
+    overhead_pct = round((prov_s - base_s) / base_s * 100, 2)
+    return _result(
+        "provenance_overhead_verdicts_per_sec", prov, "verdicts/s",
+        10_000_000.0,
+        {"batch": batch, "rounds": rounds,
+         "baseline_vps": round(base),
+         "provenance_vps": round(prov),
+         "overhead_pct": overhead_pct,
+         "overhead_under_2_5pct": overhead_pct <= 2.5,
+         "round_ms": {k: [round(t * 1e3, 1) for t in v]
+                      for k, v in times.items()}})
+
+
 CONFIGS = {
     "identity-l4": bench_identity_l4,
     "http-regex": bench_http_regex,
@@ -612,6 +694,7 @@ CONFIGS = {
     "incremental": bench_incremental,
     "flows-overhead": bench_flows_overhead,
     "tracing-overhead": bench_tracing_overhead,
+    "provenance-overhead": bench_provenance_overhead,
 }
 
 

@@ -49,6 +49,15 @@ def _collectors(daemon) -> Dict[str, Callable[[], object]]:
             "jit": jit_telemetry.report(),
             "propagation": daemon.propagation.report(50)},
         "pipeline.json": daemon.pipeline_report,
+        # verdict provenance (datapath provenance + drift audit): the
+        # compiler-correctness verdict, the heaviest denied keys, and
+        # the last replay an operator ran — "was this verdict right,
+        # and which compiled entry made it"
+        "provenance.json": lambda: {
+            "enabled": daemon.datapath.provenance_enabled,
+            "drift-audit": daemon.drift_report(),
+            "top-dropped-rules": daemon.monitor.top_dropped_rules(20),
+            "last-replay": daemon.last_replay_report()},
     }
     if getattr(daemon, "hubble", None) is not None:
         # flow observability state (hubble/): the recent flow ring, the
@@ -81,6 +90,8 @@ def _remote_collectors(client) -> Dict[str, Callable[[], object]]:
         lambda: client.get("/flows/stats?aggregated=true"),
         "traces.json": lambda: client.get("/debug/traces"),
         "pipeline.json": lambda: client.get("/debug/pipeline"),
+        "provenance.json":
+        lambda: (client.get("/healthz") or {}).get("provenance"),
     }
 
 

@@ -129,6 +129,11 @@ def cmd_status(c: Client, args) -> int:
     mp = st.get("map-pressure") or {}
     for warning in mp.get("warnings", []):
         print(f"MapPressure:   WARNING {warning}")
+    da = (st.get("provenance") or {}).get("drift-audit") or {}
+    if da.get("status") == "FAILING":
+        print(f"DriftAudit:    FAILING — {da.get('divergences', '?')} "
+              f"divergence(s) between compiled tables and the host "
+              f"policy oracle (see /debuginfo provenance)")
     if getattr(args, "verbose", False):
         # self-telemetry detail (the status --verbose surface):
         # per-map fill, compile/jit-cache accounting, tracer health,
@@ -164,6 +169,14 @@ def cmd_status(c: Client, args) -> int:
                 if delay is not None else "awaiting first verdict"
             print(f"PolicyRev:     r{rec['revision']} "
                   f"({rec['rules']} rules): {state}")
+        prov = st.get("provenance") or {}
+        if da and da.get("status") != "FAILING":
+            print(f"DriftAudit:    {da.get('status')} "
+                  f"({da.get('checked', 0)} tuples, "
+                  f"{da.get('sc-checked', 0)} label cross-checks)")
+        for rec in prov.get("top-dropped-rules") or []:
+            print(f"TopDropped:    {rec['rule']} "
+                  f"({rec['packets']} packets)")
     return 0
 
 
@@ -186,6 +199,41 @@ def cmd_policy(c: Client, args) -> int:
         out = c.delete(path)
         print(f"Revision: {out['revision']} ({out['deleted']} deleted)")
     elif args.policy_cmd == "trace":
+        if args.replay:
+            # provenance replay: through the REAL compiled device
+            # tables, not the host label simulation
+            if args.endpoint is None:
+                print("policy trace --replay requires --endpoint",
+                      file=sys.stderr)
+                return 2
+            if args.identity is None and not args.src:
+                print("policy trace --replay requires --identity or "
+                      "--src labels", file=sys.stderr)
+                return 2
+            body = {"endpoint": args.endpoint,
+                    "dport": int((args.dport or ["0"])[0]),
+                    "proto": args.proto,
+                    "direction": args.direction}
+            if args.identity is not None:
+                body["identity"] = args.identity
+            else:
+                body["labels"] = args.src
+            out = c.post("/policy/trace", body)
+            for line in out["explanation"]:
+                print(line)
+            verdict = out["device"]["verdict"]
+            print(f"Final verdict: "
+                  f"{'DENIED' if verdict < 0 else 'ALLOWED'}"
+                  + (f" (proxy {verdict})" if verdict > 0 else ""))
+            if out["drift"]:
+                print("DRIFT: device tables diverge from the host "
+                      "oracle — compiler bug", file=sys.stderr)
+                return 2
+            return 0 if verdict >= 0 else 1
+        if not args.src or not args.dst:
+            print("policy trace requires --src and --dst "
+                  "(or --replay)", file=sys.stderr)
+            return 2
         out = c.post("/policy/resolve", {
             "from": args.src, "to": args.dst,
             "dports": [int(p) for p in args.dport or []],
@@ -407,8 +455,8 @@ def cmd_hubble(c: Client, args) -> int:
         return 0
 
     params = []
-    for key in ("verdict", "drop_reason", "proto", "l7_protocol",
-                "l7_method", "l7_path", "node"):
+    for key in ("verdict", "drop_reason", "tier", "proto",
+                "l7_protocol", "l7_method", "l7_path", "node"):
         v = getattr(args, key, None)
         if v:
             params.append((key, v))
@@ -730,10 +778,23 @@ def build_parser() -> argparse.ArgumentParser:
     dele = pol_sub.add_parser("delete")
     dele.add_argument("--labels", nargs="*", default=[])
     tr = pol_sub.add_parser("trace")
-    tr.add_argument("--src", nargs="+", required=True)
-    tr.add_argument("--dst", nargs="+", required=True)
+    tr.add_argument("--src", nargs="+", default=[])
+    tr.add_argument("--dst", nargs="+", default=[])
     tr.add_argument("--dport", nargs="*")
     tr.add_argument("-v", "--verbose", action="store_true")
+    tr.add_argument("--replay", action="store_true",
+                    help="replay through the REAL compiled device "
+                         "tables (verdict provenance) instead of the "
+                         "host label simulation")
+    tr.add_argument("--endpoint", type=int, default=None,
+                    help="with --replay: local endpoint id")
+    tr.add_argument("--identity", type=int, default=None,
+                    help="with --replay: peer security identity "
+                         "(or resolve --src labels)")
+    tr.add_argument("--proto", type=int, default=6,
+                    help="with --replay: L4 protocol number")
+    tr.add_argument("--direction", default="egress",
+                    choices=["ingress", "egress"])
     val = pol_sub.add_parser("validate",
                              help="parse + sanitize locally, no import")
     val.add_argument("file", help="rules JSON file, or - for stdin")
@@ -818,6 +879,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="FORWARDED | DROPPED | REDIRECTED")
     ob.add_argument("--drop-reason", dest="drop_reason", default="",
                     help="drop reason name or code")
+    ob.add_argument("--tier", default="",
+                    help="provenance decision tier (prefilter|"
+                         "ct-established|l3-allow|l4-rule|l7-redirect"
+                         "|deny|lb) or code")
     ob.add_argument("--identity", type=int, default=None,
                     help="match src OR dst identity")
     ob.add_argument("--src-identity", dest="src_identity", type=int,

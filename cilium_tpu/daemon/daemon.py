@@ -226,6 +226,23 @@ class Daemon:
         self.ipcache.add_listener(
             lambda *_a: self._lpm_trigger.trigger("ipcache"), replay=False)
 
+        # verdict provenance (datapath/verdict.py): per-packet
+        # matched-rule + decision-tier attribution in the jitted
+        # steps, plus the periodic drift audit — the continuous
+        # correctness oracle for the policy compiler (replay through
+        # the REAL device tables vs the host SearchContext /
+        # compute_desired_policy_map_state simulations)
+        if self.config.enable_provenance:
+            self.datapath.enable_provenance()
+        self._drift_report: Optional[Dict] = None
+        self._last_replay: Optional[Dict] = None
+        self._drift_rng = np.random.default_rng(0xC111)
+        if self.config.drift_audit_interval_s > 0:
+            self.controllers.update_controller(
+                "policy-drift-audit", ControllerParams(
+                    do_func=self.run_drift_audit,
+                    run_interval=self.config.drift_audit_interval_s))
+
         # periodic CT GC (ctmap.go GC sweep analog)
         self.controllers.update_controller(
             "ct-gc", ControllerParams(
@@ -458,6 +475,268 @@ class Daemon:
                              dports=ports, verbose=verbose)
         verdict = self.repo.allows_ingress(ctx)
         return {"verdict": str(verdict), "trace": ctx.trace_output()}
+
+    # ------------------------------------- verdict provenance surfaces
+
+    def policy_trace_replay(self, endpoint_id: int,
+                            identity: Optional[int] = None,
+                            labels: Optional[Sequence[str]] = None,
+                            dport: int = 0, proto: int = 6,
+                            direction: str = "egress") -> Dict:
+        """`cilium policy trace --replay` / POST /policy/trace:
+        synthesize a header tuple for one local endpoint, run it
+        through the REAL compiled device tables, and explain the
+        verdict per tier, naming the PolicyKey that matched.  The
+        device result is diffed in-line against the host
+        compute_desired_policy_map_state oracle (the endpoint's
+        realized state), so a compiler bug surfaces as drift right in
+        the trace output.  Raises KeyError for an unknown endpoint."""
+        from ..compiler.policy_tables import oracle_provenance
+        from ..datapath.events import tier_name
+        from ..policy.mapstate import EGRESS, INGRESS
+        ep = self.endpoints.lookup(endpoint_id)
+        if ep is None or ep.table_slot is None:
+            raise KeyError(endpoint_id)
+        if identity is None:
+            if not labels:
+                raise ValueError("need identity or labels")
+            ident = self.identity_allocator.lookup_by_labels(
+                Labels.from_model(list(labels)))
+            if ident is None:
+                raise ValueError(f"no identity for labels {labels}")
+            identity = ident.id
+        dirc = EGRESS if str(direction).lower() in ("egress", "1") \
+            else INGRESS
+        realized = PolicyMapState(ep.realized)
+        row = self.datapath.policy_replay(
+            [ep.table_slot], [identity], [dport], [proto], [dirc])[0]
+        o_verdict, o_tier, o_key = oracle_provenance(
+            realized, identity, dport, proto, dirc)
+        drift = row["verdict"] != o_verdict or row["tier"] != o_tier
+
+        def key_str(k) -> str:
+            if k is None:
+                return "no entry"
+            if isinstance(k, dict):
+                return (f"PolicyKey(identity={k['identity']}, "
+                        f"dport={k['dport']}, proto={k['proto']}, "
+                        f"dir={'in' if k['direction'] == 0 else 'e'}"
+                        f"gress)")
+            return (f"PolicyKey(identity={k.identity}, "
+                    f"dport={k.dest_port}, proto={k.nexthdr}, "
+                    f"dir={'in' if k.direction == 0 else 'e'}gress)")
+
+        stage_titles = (
+            ("exact", "stage 1 exact (identity, dport, proto)"),
+            ("l3", "stage 2 L3-only (identity)"),
+            ("l4_wildcard", "stage 3 L4-wildcard (identity=0)"))
+        lines = [f"Replaying endpoint {endpoint_id} (table slot "
+                 f"{ep.table_slot}): identity {identity} -> "
+                 f"dport {dport}/proto {proto} {direction} "
+                 f"through compiled revision {self.datapath.revision}"]
+        for name, title in stage_titles:
+            st = row["stages"][name]
+            if st["found"]:
+                lines.append(
+                    f"  {title}: MATCH {key_str(st['key'])}"
+                    + (f" -> proxy {st['value']}" if st["value"] > 0
+                       else " -> allow"))
+            else:
+                lines.append(f"  {title}: no match")
+        lines.append(
+            f"  decision: tier={row['tier-name']} "
+            f"verdict={row['verdict']} "
+            f"({key_str(row['matched'])})")
+        lines.append(
+            "  oracle: " +
+            (f"DIVERGENCE — host oracle says verdict={o_verdict} "
+             f"tier={tier_name(o_tier)} ({key_str(o_key)})" if drift
+             else "device and host compute_desired_policy_map_state "
+                  "agree"))
+        out = {"endpoint": endpoint_id, "identity": identity,
+               "dport": dport, "proto": proto, "direction": direction,
+               "device": row,
+               "oracle": {"verdict": o_verdict,
+                          "tier": tier_name(o_tier),
+                          "key": key_str(o_key)},
+               "drift": drift, "explanation": lines}
+        with self._lock:
+            self._last_replay = out
+        if drift:
+            from ..utils.metrics import POLICY_DRIFT
+            POLICY_DRIFT.inc()
+        return out
+
+    def run_drift_audit(self, samples: Optional[int] = None) -> Dict:
+        """One drift-audit sweep: replay sampled tuples through the
+        compiled device tables and diff verdict+tier against the host
+        oracles.  Per endpoint the sample mixes installed keys (which
+        must keep deciding exactly as computed) with random tuples
+        (which must keep falling through identically); a handful of
+        cached identities additionally cross-check the SearchContext
+        label simulation against the realized L3 entries.  Divergences
+        found on a first pass are re-replayed once against a fresh
+        snapshot before counting, so an in-flight regeneration can't
+        fake drift.  Updates policy_drift_total and the status()
+        provenance block; returns the report."""
+        from ..compiler.policy_tables import oracle_provenance
+        from ..datapath.events import TIER_L3_ALLOW, tier_name
+        from ..policy.api import Decision
+        from ..policy.mapstate import INGRESS, PolicyKey
+        from ..utils.metrics import POLICY_DRIFT, POLICY_DRIFT_AUDIT_RUNS
+        t0 = time.time()
+        budget = samples or self.config.drift_audit_samples
+        eps = [ep for ep in self.endpoints.endpoints()
+               if ep.table_slot is not None]
+        report: Dict = {"status": "idle", "checked": 0,
+                        "sc-checked": 0, "divergences": [],
+                        "endpoints": len(eps), "skipped": 0,
+                        "last-run": t0}
+        if not eps or self.datapath._step is None:
+            with self._lock:
+                self._drift_report = report
+            return report
+        rng = self._drift_rng
+        per_ep = max(2, budget // len(eps))
+
+        rows = []  # one audit probe per row
+        for ep in eps:
+            rev = ep.policy_revision
+            state = PolicyMapState(ep.realized)
+            keys = list(state.keys())
+            picked = [keys[i] for i in
+                      rng.permutation(len(keys))[:per_ep]] if keys else []
+            tuples = []
+            for k in picked:
+                # wildcard keys get a random identity so the probe
+                # exercises the stage-3 fallback, not slot 0
+                ident = k.identity or int(rng.integers(256, 1 << 20))
+                tuples.append((ident, k.dest_port, k.nexthdr,
+                               k.direction))
+            for _ in range(max(1, per_ep // 2)):
+                tuples.append((int(rng.integers(256, 1 << 20)),
+                               int(rng.integers(1, 65536)), 6,
+                               int(rng.integers(0, 2))))
+            for t in tuples:
+                rows.append({"ep": ep, "slot": ep.table_slot,
+                             "rev": rev, "state": state, "t": t})
+
+        def replay_rows(batch):
+            return self.datapath.policy_replay(
+                [r["slot"] for r in batch],
+                [r["t"][0] for r in batch],
+                [r["t"][1] for r in batch],
+                [r["t"][2] for r in batch],
+                [r["t"][3] for r in batch])
+
+        def diverges(row, dev) -> Optional[Dict]:
+            ident, dport, proto, dirc = row["t"]
+            o_verdict, o_tier, o_key = oracle_provenance(
+                row["state"], ident, dport, proto, dirc)
+            if dev["verdict"] == o_verdict and dev["tier"] == o_tier:
+                return None
+            return {"endpoint": row["ep"].id,
+                    "tuple": {"identity": ident, "dport": dport,
+                              "proto": proto, "direction": dirc},
+                    "device": {"verdict": dev["verdict"],
+                               "tier": dev["tier-name"],
+                               "matched": dev["matched"]},
+                    "oracle": {"verdict": o_verdict,
+                               "tier": tier_name(o_tier),
+                               "key": str(o_key)},
+                    "source": "compute_desired_policy_map_state"}
+
+        suspects = []
+        checked = skipped = 0
+        for row, dev in zip(rows, replay_rows(rows)):
+            if row["ep"].policy_revision != row["rev"]:
+                skipped += 1
+                continue
+            checked += 1
+            d = diverges(row, dev)
+            if d is not None:
+                suspects.append((row, d))
+        # second look: a regeneration between snapshot and replay can
+        # fake drift — re-snapshot + re-replay just the suspects and
+        # keep only the persistent ones
+        divergences = []
+        if suspects:
+            retry = []
+            for row, _d in suspects:
+                retry.append({**row,
+                              "rev": row["ep"].policy_revision,
+                              "state": PolicyMapState(
+                                  row["ep"].realized)})
+            for row, dev in zip(retry, replay_rows(retry)):
+                d = diverges(row, dev)
+                if d is not None and \
+                        row["ep"].policy_revision == row["rev"]:
+                    divergences.append(d)
+
+        # SearchContext cross-check (policy/trace.py simulation):
+        # repo label decision -> realized L3 entry -> device l3-allow
+        # tier must tell one story for identities with known labels
+        sc_checked = 0
+        cache = IdentityCache.snapshot(self.identity_allocator)
+        sc_idents = list(cache.items())
+        sc_idents = [sc_idents[i]
+                     for i in rng.permutation(len(sc_idents))]
+        for ep in eps[:4]:
+            if ep.policy_revision < self.repo.revision:
+                continue  # not yet regenerated against current rules
+            cfg = ep.policy_config(self.config.always_allow_localhost())
+            if not cfg.ingress_enforcement:
+                continue  # every identity legitimately gets an L3 key
+            state = PolicyMapState(ep.realized)
+            ep_labels = ep.label_array()
+            for num, id_labels in sc_idents[:4]:
+                ctx = SearchContext(from_labels=id_labels,
+                                    to_labels=ep_labels)
+                decision = self.repo.allows_ingress_label_access(ctx)
+                has_l3 = PolicyKey(identity=num,
+                                   direction=INGRESS) in state
+                dev = self.datapath.policy_replay(
+                    [ep.table_slot], [num], [0], [0], [INGRESS])[0]
+                dev_l3 = dev["tier"] == TIER_L3_ALLOW and \
+                    dev["verdict"] == 0
+                sc_checked += 1
+                if (decision == Decision.ALLOWED) != has_l3 or \
+                        has_l3 != dev_l3:
+                    if ep.policy_revision < self.repo.revision:
+                        continue  # regeneration raced the check
+                    divergences.append({
+                        "endpoint": ep.id,
+                        "tuple": {"identity": num, "dport": 0,
+                                  "proto": 0, "direction": INGRESS},
+                        "device": {"verdict": dev["verdict"],
+                                   "tier": dev["tier-name"]},
+                        "oracle": {
+                            "search-context": str(decision),
+                            "realized-l3-entry": has_l3},
+                        "source": "SearchContext"})
+
+        if divergences:
+            POLICY_DRIFT.inc(len(divergences))
+        POLICY_DRIFT_AUDIT_RUNS.inc(labels={
+            "result": "drift" if divergences else "ok"})
+        report.update(
+            status="FAILING" if divergences else "ok",
+            checked=checked, skipped=skipped, sc_checked=sc_checked,
+            divergences=divergences[:16],
+            duration_s=round(time.time() - t0, 4))
+        report["sc-checked"] = report.pop("sc_checked")
+        report["duration-s"] = report.pop("duration_s")
+        with self._lock:
+            self._drift_report = report
+        return report
+
+    def drift_report(self) -> Optional[Dict]:
+        with self._lock:
+            return self._drift_report
+
+    def last_replay_report(self) -> Optional[Dict]:
+        with self._lock:
+            return self._last_replay
 
     # -------------------------------------------------- regeneration
 
@@ -931,9 +1210,30 @@ class Daemon:
             # flow observability health (hubble observer + relay)
             "hubble": self.hubble.stats()
             if self.hubble is not None else None,
+            # verdict provenance + the drift audit's correctness
+            # verdict on the policy compiler: "FAILING" here means the
+            # compiled device tables and the host oracle disagree —
+            # the loudest signal status() can carry
+            "provenance": self._provenance_status(),
             # runtime capability probes (bpf/run_probes.sh analog)
             "features": self._features(),
         }
+
+    def _provenance_status(self) -> Dict:
+        report = self.drift_report()
+        summary = None
+        if report is not None:
+            summary = {"status": report.get("status"),
+                       "checked": report.get("checked", 0),
+                       "sc-checked": report.get("sc-checked", 0),
+                       "last-run": report.get("last-run"),
+                       "divergences":
+                       len(report.get("divergences") or [])}
+            if summary["divergences"]:
+                summary["detail"] = report["divergences"][:5]
+        return {"enabled": self.datapath.provenance_enabled,
+                "drift-audit": summary,
+                "top-dropped-rules": self.monitor.top_dropped_rules(5)}
 
     def _features(self) -> Dict:
         cached = getattr(self, "_features_cache", None)

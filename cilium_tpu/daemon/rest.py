@@ -105,6 +105,30 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, d.policy_resolve(
                     frm, to, dports=body.get("dports"),
                     verbose=bool(body.get("verbose"))))
+            if path == "/policy/trace" and method in ("GET", "POST"):
+                # verdict-provenance replay: run the tuple through
+                # the REAL compiled device tables and explain the
+                # verdict per tier (daemon.policy_trace_replay);
+                # query params work for GET, a JSON body for POST
+                body = json.loads(self._body() or b"{}")
+                for k in ("endpoint", "identity", "dport", "proto",
+                          "direction", "labels"):
+                    if k not in body and k in qs:
+                        body[k] = qs[k] if k == "labels" else qs[k][0]
+                if "endpoint" not in body:
+                    return self._error(400, "endpoint required")
+                try:
+                    out = d.policy_trace_replay(
+                        int(body["endpoint"]),
+                        identity=int(body["identity"])
+                        if body.get("identity") is not None else None,
+                        labels=body.get("labels"),
+                        dport=int(body.get("dport", 0)),
+                        proto=int(body.get("proto", 6)),
+                        direction=str(body.get("direction", "egress")))
+                except KeyError:
+                    return self._error(404, "endpoint not found")
+                return self._send(200, out)
             if path == "/debug/traces" and method == "GET":
                 # span-trace surface (observability/tracer.py):
                 # ?id=<trace> or ?revision=<rev> returns one span
@@ -160,6 +184,15 @@ class _Handler(BaseHTTPRequestHandler):
                         "pipeline": d.pipeline_report(),
                         "map-pressure": d.datapath.map_pressure(
                             d.config.map_pressure_warn)},
+                    # verdict provenance: drift-audit verdict on the
+                    # compiler, the heaviest denied keys, and the
+                    # last replay report — "was this verdict right"
+                    "provenance": {
+                        "enabled": d.datapath.provenance_enabled,
+                        "drift-audit": d.drift_report(),
+                        "top-dropped-rules":
+                        d.monitor.top_dropped_rules(20),
+                        "last-replay": d.last_replay_report()},
                 })
             m = re.fullmatch(r"/kvstore/(.+)", path)
             if m:

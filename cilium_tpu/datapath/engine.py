@@ -34,8 +34,9 @@ from .pipeline import (DatapathTables, FullPacketBatch, FullPacketBatch6,
                        FullTables, FullTables6, build_tables,
                        full_datapath_step, full_datapath_step6,
                        lpm6_tables)
+from .events import format_rule
 from .prefilter import PreFilter
-from .verdict import Counters
+from .verdict import Counters, Provenance, _explain_jit, make_packet_batch
 
 
 class Datapath:
@@ -104,6 +105,15 @@ class Datapath:
         self.on_revision_served = None  # callable(revision)
         self._served_revision = 0
         self._pending_verdicts: List = []
+        # verdict provenance (datapath/verdict.py Provenance): when
+        # enabled, both family steps additionally emit the matched
+        # policymap slot + decision tier per packet; the last batch's
+        # pair is kept for the observability consumers.  Disabled =
+        # the exact pre-provenance compiled program (one static flag).
+        self.provenance_enabled = False
+        self.last_provenance: Optional[Provenance] = None
+        self._replay_probe = 1
+        self._prov_decode_cache = None
 
     def enable_flow_aggregation(self, slots: int = 1 << 12,
                                 max_probe: int = 8,
@@ -135,6 +145,27 @@ class Datapath:
             if self.flows is None:
                 return
             self.flows = None
+            if self._step is not None:
+                self._rebuild()
+
+    def enable_provenance(self) -> None:
+        """Turn on per-packet verdict provenance: the jitted family
+        steps additionally emit (matched policymap slot, decision
+        tier) — see datapath/events.py TIER_*.  Re-jits the steps;
+        the compiled program gains two [B] int32 outputs."""
+        with self._lock:
+            if self.provenance_enabled:
+                return
+            self.provenance_enabled = True
+            if self._step is not None:
+                self._rebuild()
+
+    def disable_provenance(self) -> None:
+        with self._lock:
+            if not self.provenance_enabled:
+                return
+            self.provenance_enabled = False
+            self.last_provenance = None
             if self._step is not None:
                 self._rebuild()
 
@@ -406,6 +437,14 @@ class Datapath:
             # whole fused program on the CPU backend (XLA copies the
             # donated buffers out of line), and the table is ~1MB —
             # double-buffering it costs nothing
+        # omitting the kwarg entirely when provenance is off keeps the
+        # disabled partial byte-identical to the pre-provenance one
+        if self.provenance_enabled:
+            flow_kwargs = dict(flow_kwargs, with_provenance=1)
+        # replay runs verdict_explain over the live policy tensors;
+        # it needs the same probe depth the hot path compiled with
+        self._replay_probe = policy_probe
+        self._prov_decode_cache = None
         v4_static = dict(
             policy_probe=policy_probe,
             lpm_probe=max(1, self.compiled_ipcache.max_probe),
@@ -475,15 +514,21 @@ class Datapath:
             if self.flows is not None:
                 step = self._flow_step_variant(self._step,
                                                self._step_nc)
-                (verdict, event, identity, nat, self.ct.state,
-                 self.counters, self.flows.state) = step(
-                    self._tables, self.ct.state, self.counters, pkt,
-                    ts, self.flows.state)
+                outs = step(self._tables, self.ct.state, self.counters,
+                            pkt, ts, self.flows.state)
             else:
                 step = self._step
-                (verdict, event, identity, nat,
-                 self.ct.state, self.counters) = step(
-                    self._tables, self.ct.state, self.counters, pkt, ts)
+                outs = step(self._tables, self.ct.state, self.counters,
+                            pkt, ts)
+            verdict, event, identity, nat = outs[:4]
+            self.ct.state, self.counters = outs[4], outs[5]
+            tail = 6
+            if self.flows is not None:
+                self.flows.state = outs[tail]
+                tail += 1
+            if self.provenance_enabled:
+                self.last_provenance = Provenance(outs[tail],
+                                                  outs[tail + 1])
             if telem:
                 self._account_dispatch("engine-v4", "datapath.process",
                                        step, pkt.endpoint.shape[0],
@@ -507,16 +552,21 @@ class Datapath:
             if self.flows is not None:
                 step = self._flow_step_variant(self._step6,
                                                self._step6_nc)
-                (verdict, event, identity, nat, self.ct6.state,
-                 self.counters, self.flows.state) = step(
-                    self._tables6, self.ct6.state, self.counters, pkt,
-                    ts, self.flows.state)
+                outs = step(self._tables6, self.ct6.state,
+                            self.counters, pkt, ts, self.flows.state)
             else:
                 step = self._step6
-                (verdict, event, identity, nat,
-                 self.ct6.state, self.counters) = step(
-                    self._tables6, self.ct6.state, self.counters, pkt,
-                    ts)
+                outs = step(self._tables6, self.ct6.state,
+                            self.counters, pkt, ts)
+            verdict, event, identity, nat = outs[:4]
+            self.ct6.state, self.counters = outs[4], outs[5]
+            tail = 6
+            if self.flows is not None:
+                self.flows.state = outs[tail]
+                tail += 1
+            if self.provenance_enabled:
+                self.last_provenance = Provenance(outs[tail],
+                                                  outs[tail + 1])
             if telem:
                 self._account_dispatch("engine-v6", "datapath.process6",
                                        step, pkt.endpoint.shape[0],
@@ -598,6 +648,111 @@ class Datapath:
             self.on_revision_served(revision)
         except Exception:  # noqa: BLE001 — telemetry must never
             pass           # poison the verdict path
+
+    # -- verdict provenance (replay + slot decode) ---------------------------
+
+    def rule_decoder(self):
+        """Host decoder for provenance match slots: a closure mapping
+        a flat [E*S] slot to the compiled PolicyKey words at that slot
+        of the LIVE device policy tensors (None for -1/empty/out of
+        range).  The tensor->numpy transfer is cached per tensor
+        generation, so decoding many sampled slots costs one read."""
+        with self._lock:
+            if self._tables is None:
+                return lambda slot: None
+            key_id = self._tables.datapath.key_id
+            key_meta = self._tables.datapath.key_meta
+            value = self._tables.datapath.value
+            cache = self._prov_decode_cache
+        if cache is None or cache[0] is not key_id:
+            arrays = (np.asarray(key_id).reshape(-1),
+                      np.asarray(key_meta).reshape(-1),
+                      np.asarray(value).reshape(-1),
+                      int(key_id.shape[-1]))
+            with self._lock:
+                self._prov_decode_cache = (key_id, arrays)
+        else:
+            arrays = cache[1]
+        flat_id, flat_meta, flat_value, slots = arrays
+
+        def decode(slot) -> Optional[Dict]:
+            slot = int(slot)
+            if slot < 0 or slot >= flat_meta.shape[0]:
+                return None
+            meta = int(flat_meta[slot])
+            if meta == 0:
+                return None  # slot emptied since the batch ran
+            return {"endpoint-slot": slot // slots,
+                    "slot": slot % slots,
+                    "identity": int(np.uint32(flat_id[slot])),
+                    "dport": (meta >> 16) & 0xFFFF,
+                    "proto": (meta >> 8) & 0xFF,
+                    "direction": (meta >> 1) & 1,
+                    "proxy-port": int(flat_value[slot])}
+        return decode
+
+    def provenance_rule_of(self):
+        """String form of rule_decoder for the monitor/hubble surfaces
+        ('' for unmatched slots)."""
+        decode = self.rule_decoder()
+
+        def rule_of(slot) -> str:
+            return format_rule(decode(slot))
+        return rule_of
+
+    def policy_replay(self, endpoints, identities, dports, protos,
+                      directions) -> List[Dict]:
+        """Run a synthesized header batch through the REAL compiled
+        policy tensors serving traffic right now (`cilium policy
+        trace --replay` / the drift audit's device side).  Pure read:
+        no counters, no CT, no flow table — verdict_explain shares
+        the hot path's stage lookups, so the verdicts are bit-exact
+        with what `process()` would decide for a new flow.
+
+        Args are equal-length sequences: endpoint TABLE SLOTS (not
+        endpoint ids), identities, dports, protos, directions.
+        Returns one dict per row with the final verdict/tier/slot,
+        the decoded matched key, and each stage's outcome."""
+        from .events import tier_name
+        with self._lock:
+            if self._tables is None:
+                raise RuntimeError("no policy loaded")
+            key_id = self._tables.datapath.key_id
+            key_meta = self._tables.datapath.key_meta
+            value = self._tables.datapath.value
+            probe = self._replay_probe
+        pkt = make_packet_batch(endpoints, identities, dports, protos,
+                                directions)
+        res = _explain_jit(key_id, key_meta, value, pkt,
+                           max_probe=probe)
+        res = jax.tree_util.tree_map(np.asarray, res)
+        decode = self.rule_decoder()
+        eps, ids, dps, prs, dirs = (np.asarray(a) for a in (
+            endpoints, identities, dports, protos, directions))
+        out: List[Dict] = []
+        for i in range(eps.shape[0]):
+            stages = {}
+            for name in ("exact", "l3", "l4_wildcard"):
+                st = res[name]
+                found = bool(st["found"][i])
+                stages[name] = {
+                    "found": found,
+                    "value": int(st["value"][i]),
+                    "key": decode(st["slot"][i]) if found else None}
+            slot = int(res["slot"][i])
+            out.append({
+                "endpoint-slot": int(eps[i]),
+                "identity": int(ids[i]),
+                "dport": int(dps[i]),
+                "proto": int(prs[i]),
+                "direction": int(dirs[i]),
+                "verdict": int(res["verdict"][i]),
+                "tier": int(res["tier"][i]),
+                "tier-name": tier_name(int(res["tier"][i])),
+                "slot": slot,
+                "matched": decode(slot) if slot >= 0 else None,
+                "stages": stages})
+        return out
 
     def map_pressure(self, warn_threshold: float = 0.9) -> Dict:
         """Map-pressure report over the live device tables (updates

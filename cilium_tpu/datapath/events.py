@@ -53,3 +53,62 @@ def event_name(code: int) -> str:
     """Human name for any event code (drop reason or trace point)."""
     return DROP_NAMES.get(code) or TRACE_NAMES.get(code) or \
         f"code {code}"
+
+
+# ---------------------------------------------------------------------------
+# Verdict provenance: decision tiers
+# ---------------------------------------------------------------------------
+#
+# When provenance is enabled the jitted pipelines emit, per packet,
+# WHICH stage of the fused program produced the final verdict — the
+# fallback chain of bpf/lib/policy.h __policy_can_access plus the
+# stages that short-circuit around it (XDP prefilter, the CT fast
+# path, the in-datapath local responder).  Alongside the tier the
+# policy tiers also emit the matched policymap entry's flat slot, so
+# the host can name the exact compiled PolicyKey that decided.
+
+TIER_NONE = 0            # provenance disabled / not applicable
+TIER_PREFILTER = 1       # XDP prefilter deny (bpf_xdp.c check_filters)
+TIER_CT_ESTABLISHED = 2  # verdict replayed from the CT entry
+TIER_L3_ALLOW = 3        # L3-only key (identity, 0, 0, dir)
+TIER_L4_RULE = 4         # exact or L4-wildcard key, plain allow
+TIER_L7_REDIRECT = 5     # matched key carries a proxy port
+TIER_DENY = 6            # no key matched (policy/fragment drop)
+TIER_LB = 7              # answered by the local service tier (ICMPv6
+#                          NS/echo responder; nothing reaches policy)
+
+TIER_NAMES = {
+    TIER_NONE: "none",
+    TIER_PREFILTER: "prefilter",
+    TIER_CT_ESTABLISHED: "ct-established",
+    TIER_L3_ALLOW: "l3-allow",
+    TIER_L4_RULE: "l4-rule",
+    TIER_L7_REDIRECT: "l7-redirect",
+    TIER_DENY: "deny",
+    TIER_LB: "lb",
+}
+
+
+def tier_name(code: int) -> str:
+    """Human name for a provenance decision-tier code."""
+    return TIER_NAMES.get(code, f"tier {code}")
+
+
+def format_rule(decoded) -> str:
+    """Compact one-line form of a decoded policymap entry (the label
+    value the provenance metrics and monitor samples carry); '' for
+    None (no entry decided)."""
+    if decoded is None:
+        return ""
+    direction = "ingress" if decoded["direction"] == 0 else "egress"
+    s = (f"identity={decoded['identity']},dport={decoded['dport']},"
+         f"proto={decoded['proto']},{direction}")
+    if decoded.get("proxy-port"):
+        s += f",proxy={decoded['proxy-port']}"
+    return s
+
+
+def format_denied_key(identity: int, dport: int, proto: int) -> str:
+    """The queried tuple a DENY verdict failed to match — the 'rule
+    key' drops aggregate under (no compiled entry decided them)."""
+    return f"deny:identity={identity},dport={dport},proto={proto}"

@@ -206,7 +206,8 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                        lb_probe: int, ct_slots: int, ct_probe: int,
                        tun_probe: int = 0, flow_slots: int = 0,
                        flow_probe: int = 0,
-                       flow_claim_budget: int = 1024):
+                       flow_claim_budget: int = 1024,
+                       with_provenance: int = 0):
     """The batched equivalent of the reference's per-packet egress path
     (bpf_lxc.c:432 handle_ipv4_from_lxc): XDP prefilter drop, service
     DNAT (lb4_local), conntrack lookup, ipcache identity resolve, policy
@@ -219,6 +220,11 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
 
     Returns (verdict [B], event [B], identity [B], ct', counters').
     Verdict: -N drop code / 0 allow / >0 proxy port.
+
+    ``with_provenance`` (static) appends two [B] int32 outputs: the
+    matched policymap entry's flat slot (-1 = no entry decided) and
+    the decision-tier code (events.TIER_*).  0 keeps the compiled
+    program identical to the pre-provenance step.
     """
     from .conntrack import CT_NEW, CTBatch, ct_step
     from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_PREFILTER,
@@ -273,9 +279,15 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                      dport=dport, proto=pkt.proto,
                      direction=pkt.direction, length=pkt.length,
                      is_fragment=pkt.is_fragment)
-    pol_verdict, counters = verdict_step(
-        tables.datapath.key_id, tables.datapath.key_meta,
-        tables.datapath.value, counters, vb, policy_probe)
+    if with_provenance:
+        pol_verdict, counters, pol_slot, pol_tier = verdict_step(
+            tables.datapath.key_id, tables.datapath.key_meta,
+            tables.datapath.value, counters, vb, policy_probe,
+            with_provenance=True)
+    else:
+        pol_verdict, counters = verdict_step(
+            tables.datapath.key_id, tables.datapath.key_meta,
+            tables.datapath.value, counters, vb, policy_probe)
 
     # 6. CT step. Creation is gated on the policy allowing the flow
     # (bpf_lxc.c:545 ct_create4 after policy_can_egress); prefilter-
@@ -340,6 +352,7 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
     nat = NATResult(daddr=daddr, dport=dport, saddr=nat_saddr,
                     sport=nat_sport, rev_nat=ct_rev_nat,
                     tunnel_ep=tun_ep_out, tunnel_id=tun_id_out)
+    out = (verdict, event, identity, nat, ct, counters)
     if flows is not None and flow_slots > 0:
         # 10. Hubble on-device flow aggregation: the same compiled
         # program that produced the verdict reduces per-flow state —
@@ -354,8 +367,20 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
             flows, src_id, dst_id, dport, pkt.proto, event,
             pkt.length, now, slots=flow_slots, max_probe=flow_probe,
             claim_budget=flow_claim_budget)
-        return verdict, event, identity, nat, ct, counters, flows
-    return verdict, event, identity, nat, ct, counters
+        out = out + (flows,)
+    if with_provenance:
+        # 11. Provenance finalization: mirror the final-verdict
+        # precedence (step 7) — prefilter beats everything, CT
+        # fast-path hits next, then the policy tiers.  Slots stay -1
+        # wherever no compiled policymap entry decided.
+        from .events import TIER_CT_ESTABLISHED, TIER_PREFILTER
+        tier = jnp.where(
+            pf_hit, jnp.int32(TIER_PREFILTER),
+            jnp.where(established, jnp.int32(TIER_CT_ESTABLISHED),
+                      pol_tier))
+        slot = jnp.where(pf_hit | established, jnp.int32(-1), pol_slot)
+        out = out + (slot, tier)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -471,7 +496,8 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                         pf6_probe: int, ct_slots: int, ct_probe: int,
                         lb6_probe: int = 0, flow_slots: int = 0,
                         flow_probe: int = 0,
-                        flow_claim_budget: int = 1024):
+                        flow_claim_budget: int = 1024,
+                        with_provenance: int = 0):
     """The v6 twin of full_datapath_step (bpf_lxc.c:745 ipv6_policy):
     prefilter drop, service DNAT (lb6_local), conntrack, ipcache
     identity, policy verdict for CT_NEW flows, CT create gated on the
@@ -567,9 +593,15 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                      dport=dport, proto=pkt.proto,
                      direction=pkt.direction, length=pkt.length,
                      is_fragment=pkt.is_fragment)
-    pol_verdict, counters = verdict_step(
-        tables.key_id, tables.key_meta, tables.value, counters, vb,
-        policy_probe, count_mask=~icmp6_handled)
+    if with_provenance:
+        pol_verdict, counters, pol_slot, pol_tier = verdict_step(
+            tables.key_id, tables.key_meta, tables.value, counters,
+            vb, policy_probe, count_mask=~icmp6_handled,
+            with_provenance=True)
+    else:
+        pol_verdict, counters = verdict_step(
+            tables.key_id, tables.key_meta, tables.value, counters, vb,
+            policy_probe, count_mask=~icmp6_handled)
 
     # 6. CT step, creation gated on the verdict; new entries record the
     # flow's rev-NAT index so replies can restore the VIP.  Locally
@@ -613,6 +645,7 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                                       jnp.int32(TRACE_TO_LXC))))))))
     nat = NAT6Result(daddr=daddr, dport=dport, saddr=nat_saddr,
                      sport=nat_sport, rev_nat=ct_rev_nat)
+    out = (verdict, event, identity, nat, ct, counters)
     if flows is not None and flow_slots > 0:
         # Hubble flow aggregation, v6 twin (flow keys are identity-
         # based, so the table is family-agnostic like the policy
@@ -626,5 +659,21 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
             flows, src_id, dst_id, dport, pkt.proto, event,
             pkt.length, now, slots=flow_slots, max_probe=flow_probe,
             claim_budget=flow_claim_budget)
-        return verdict, event, identity, nat, ct, counters, flows
-    return verdict, event, identity, nat, ct, counters
+        out = out + (flows,)
+    if with_provenance:
+        # Provenance finalization, mirroring the v6 verdict
+        # precedence: prefilter, then the local ICMPv6 responder
+        # (answered OR unknown-target dropped — either way the local
+        # service tier decided, not policy), then CT, then policy.
+        from .events import (TIER_CT_ESTABLISHED, TIER_LB,
+                             TIER_PREFILTER)
+        tier = jnp.where(
+            pf_hit, jnp.int32(TIER_PREFILTER),
+            jnp.where(icmp6_handled, jnp.int32(TIER_LB),
+                      jnp.where(established,
+                                jnp.int32(TIER_CT_ESTABLISHED),
+                                pol_tier)))
+        slot = jnp.where(pf_hit | icmp6_handled | established,
+                         jnp.int32(-1), pol_slot)
+        out = out + (slot, tier)
+    return out

@@ -49,23 +49,25 @@ class Counters(NamedTuple):
     bytes: jnp.ndarray    # [E*S] uint32
 
 
+class Provenance(NamedTuple):
+    """Per-packet verdict provenance (both [B] int32): the flat slot
+    of the matched policymap entry in the stacked [E*S] tables (-1 =
+    no entry decided), and the decision-tier code (events.TIER_*)."""
+
+    match_slot: jnp.ndarray
+    tier: jnp.ndarray
+
+
 def _pack_meta_vec(dport, proto, direction):
     return ((dport & 0xFFFF) << 16) | ((proto & 0xFF) << 8) | \
         ((direction & 1) << 1) | 1
 
 
-def verdict_step(key_id: jnp.ndarray, key_meta: jnp.ndarray,
-                 value: jnp.ndarray, counters: Counters,
-                 pkt: PacketBatch, max_probe: int,
-                 count_mask: "jnp.ndarray | None" = None
-                 ) -> Tuple[jnp.ndarray, Counters]:
-    """Pure batched verdict function (jit/shard_map friendly).
-
-    ``count_mask`` (bool [B]) excludes rows from the per-entry
-    packet/byte counters without changing their verdicts — used for
-    packets another stage already answered terminally (ICMPv6
-    NS/echo), which in the reference never reach the policy program
-    at all (bpf_lxc.c calls icmp6_handle before policy)."""
+def _stage_lookups(key_id, key_meta, value, pkt: PacketBatch,
+                   max_probe: int):
+    """The 3-stage fallback chain's lookups (policy.h:46-110), with
+    fragment gating applied: fragments can't be matched at L4
+    (policy.h:60,99), so only the L3 stage applies to them."""
     frag = pkt.is_fragment.astype(bool)
     meta_exact = _pack_meta_vec(pkt.dport, pkt.proto, pkt.direction)
     meta_l3 = _pack_meta_vec(jnp.zeros_like(pkt.dport),
@@ -78,11 +80,54 @@ def verdict_step(key_id: jnp.ndarray, key_meta: jnp.ndarray,
                                 meta_l3, max_probe, row=pkt.endpoint)
     f3, v3, s3 = batched_lookup(key_id, key_meta, value, zero_id,
                                 meta_exact, max_probe, row=pkt.endpoint)
-
-    # Fragments can't be matched at L4 (policy.h:60,99): only the L3 stage
-    # applies; an L3 miss drops with the fragment code.
     f1 = f1 & ~frag
     f3 = f3 & ~frag
+    return frag, (f1, v1, s1), (f2, v2, s2), (f3, v3, s3)
+
+
+def _policy_provenance(pkt: PacketBatch, f1, v1, s1, f2, s2, f3, v3,
+                       s3) -> Provenance:
+    """Matched slot + decision tier from the stage outcomes.  The
+    tier names the kind of compiled key that decided: an exact-stage
+    hit whose query has dport==0 and proto==0 IS the L3-only key
+    (identical packed words), so it reports as l3-allow."""
+    from .events import (TIER_DENY, TIER_L3_ALLOW, TIER_L4_RULE,
+                         TIER_L7_REDIRECT)
+    exact_is_l3 = (pkt.dport == 0) & (pkt.proto == 0)
+    tier1 = jnp.where(
+        v1 > 0, jnp.int32(TIER_L7_REDIRECT),
+        jnp.where(exact_is_l3, jnp.int32(TIER_L3_ALLOW),
+                  jnp.int32(TIER_L4_RULE)))
+    tier3 = jnp.where(v3 > 0, jnp.int32(TIER_L7_REDIRECT),
+                      jnp.int32(TIER_L4_RULE))
+    tier = jnp.where(
+        f1, tier1,
+        jnp.where(f2, jnp.int32(TIER_L3_ALLOW),
+                  jnp.where(f3, tier3, jnp.int32(TIER_DENY))))
+    hit = f1 | f2 | f3
+    slot = jnp.where(hit, jnp.where(f1, s1, jnp.where(f2, s2, s3)),
+                     jnp.int32(-1))
+    return Provenance(match_slot=slot, tier=tier)
+
+
+def verdict_step(key_id: jnp.ndarray, key_meta: jnp.ndarray,
+                 value: jnp.ndarray, counters: Counters,
+                 pkt: PacketBatch, max_probe: int,
+                 count_mask: "jnp.ndarray | None" = None,
+                 with_provenance: bool = False):
+    """Pure batched verdict function (jit/shard_map friendly).
+
+    ``count_mask`` (bool [B]) excludes rows from the per-entry
+    packet/byte counters without changing their verdicts — used for
+    packets another stage already answered terminally (ICMPv6
+    NS/echo), which in the reference never reach the policy program
+    at all (bpf_lxc.c calls icmp6_handle before policy).
+
+    ``with_provenance`` (static) additionally returns a Provenance
+    pair (matched flat slot, decision tier); False keeps the program
+    bit-identical to the plain two-output variant."""
+    frag, (f1, v1, s1), (f2, v2, s2), (f3, v3, s3) = _stage_lookups(
+        key_id, key_meta, value, pkt, max_probe)
 
     verdict = jnp.where(
         f1, v1,
@@ -101,7 +146,39 @@ def verdict_step(key_id: jnp.ndarray, key_meta: jnp.ndarray,
                       jnp.uint32(0))
     packets = counters.packets.at[hit_slot].add(inc_p)
     bytes_ = counters.bytes.at[hit_slot].add(inc_b)
-    return verdict, Counters(packets=packets, bytes=bytes_)
+    out = Counters(packets=packets, bytes=bytes_)
+    if with_provenance:
+        prov = _policy_provenance(pkt, f1, v1, s1, f2, s2, f3, v3, s3)
+        return verdict, out, prov.match_slot, prov.tier
+    return verdict, out
+
+
+def verdict_explain(key_id: jnp.ndarray, key_meta: jnp.ndarray,
+                    value: jnp.ndarray, pkt: PacketBatch,
+                    max_probe: int) -> Dict:
+    """Replay-grade breakdown: every stage's outcome plus the final
+    verdict/tier/slot, over the SAME lookups the hot path runs
+    (shared ``_stage_lookups`` — bit-exact by construction).  No
+    counter side effects; this is the `policy trace --replay` and
+    drift-audit entry (engine.Datapath.policy_replay)."""
+    frag, (f1, v1, s1), (f2, v2, s2), (f3, v3, s3) = _stage_lookups(
+        key_id, key_meta, value, pkt, max_probe)
+    verdict = jnp.where(
+        f1, v1,
+        jnp.where(f2, jnp.int32(VERDICT_ALLOW),
+                  jnp.where(f3, v3,
+                            jnp.where(frag, jnp.int32(VERDICT_DROP_FRAG),
+                                      jnp.int32(VERDICT_DROP)))))
+    prov = _policy_provenance(pkt, f1, v1, s1, f2, s2, f3, v3, s3)
+    return {
+        "verdict": verdict, "tier": prov.tier, "slot": prov.match_slot,
+        "exact": {"found": f1, "value": v1, "slot": s1},
+        "l3": {"found": f2, "value": v2, "slot": s2},
+        "l4_wildcard": {"found": f3, "value": v3, "slot": s3},
+    }
+
+
+_explain_jit = jax.jit(verdict_explain, static_argnames=("max_probe",))
 
 
 class VerdictEngine:

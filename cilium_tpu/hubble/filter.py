@@ -13,7 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, fields
 from typing import Dict, Optional
 
-from ..datapath.events import DROP_NAMES
+from ..datapath.events import DROP_NAMES, TIER_NAMES
 from .flow import FlowRecord, PROTO_NAMES
 
 _PROTO_NUMBERS = {v.lower(): k for k, v in PROTO_NAMES.items()}
@@ -35,6 +35,23 @@ def parse_verdict(value: str) -> str:
         raise ValueError(f"unknown verdict {value!r} "
                          "(FORWARDED|DROPPED|REDIRECTED)")
     return v
+
+
+def parse_tier(value) -> str:
+    """Decision-tier name (case-insensitive) or numeric tier code."""
+    s = str(value).strip()
+    try:
+        code = int(s)
+    except ValueError:
+        lowered = s.lower()
+        if lowered in TIER_NAMES.values():
+            return lowered
+        raise ValueError(
+            f"unknown decision tier {value!r} "
+            f"({'|'.join(sorted(set(TIER_NAMES.values())))})") from None
+    if code not in TIER_NAMES:
+        raise ValueError(f"unknown tier code {code}")
+    return TIER_NAMES[code]
 
 
 def parse_drop_reason(value) -> str:
@@ -63,6 +80,7 @@ class FlowFilter:
     endpoint: Optional[int] = None
     verdict: Optional[str] = None        # FORWARDED|DROPPED|REDIRECTED
     drop_reason: Optional[str] = None    # DROP_NAMES value
+    tier: Optional[str] = None           # TIER_NAMES value (provenance)
     dport: Optional[int] = None
     proto: Optional[int] = None
     l7_protocol: Optional[str] = None
@@ -90,6 +108,8 @@ class FlowFilter:
             return False
         if self.drop_reason is not None and \
                 f.drop_reason != self.drop_reason:
+            return False
+        if self.tier is not None and f.tier != self.tier:
             return False
         if self.dport is not None and f.dport != self.dport:
             return False
@@ -141,6 +161,9 @@ class FlowFilter:
         v = first("drop_reason")
         if v:
             flt.drop_reason = parse_drop_reason(v)
+        v = first("tier")
+        if v:
+            flt.tier = parse_tier(v)
         v = first("proto")
         if v:
             flt.proto = parse_proto(v)

@@ -50,6 +50,11 @@ class FlowRecord:
     length: int = 0
     event: int = 0           # raw datapath event code (0 for L7)
     drop_reason: str = ""    # DROP_NAMES entry when verdict == DROPPED
+    # verdict provenance ("" when disabled): decision-tier name
+    # (events.TIER_NAMES value) and the compiled rule key that
+    # decided — matched policymap entry, or the denied query key
+    tier: str = ""
+    matched_rule: str = ""
     l7_protocol: str = ""    # "http" | "dns" | "kafka" | parser name
     l7_method: str = ""      # HTTP method / kafka api / dns qtype
     l7_path: str = ""        # HTTP path / kafka topic / dns name
@@ -67,6 +72,10 @@ class FlowRecord:
                 f"->{self.dst_identity} dport={self.dport} {proto}")
         if self.drop_reason:
             base += f" ({self.drop_reason})"
+        if self.tier:
+            base += f" tier={self.tier}"
+        if self.matched_rule:
+            base += f" rule={self.matched_rule}"
         if self.l7_protocol:
             base += (f" {self.l7_protocol}"
                      f" {self.l7_method} {self.l7_path}").rstrip()
@@ -81,6 +90,8 @@ def flow_from_dict(d: Dict) -> FlowRecord:
 
 def flow_from_event(ev, node: str, seq: int = 0) -> FlowRecord:
     """Sampled datapath event (monitor.MonitorEvent, kind "") -> flow."""
+    from ..datapath.events import TIER_NAMES
+    tier = getattr(ev, "tier", 0)
     return FlowRecord(
         seq=seq, timestamp=ev.timestamp, node=node,
         verdict=verdict_of_event(ev.code),
@@ -88,6 +99,8 @@ def flow_from_event(ev, node: str, seq: int = 0) -> FlowRecord:
         endpoint=ev.endpoint, dport=ev.dport, proto=ev.proto,
         length=ev.length, event=ev.code,
         drop_reason=DROP_NAMES.get(ev.code, "") if ev.code < 0 else "",
+        tier=TIER_NAMES.get(tier, str(tier)) if tier else "",
+        matched_rule=getattr(ev, "matched_rule", ""),
         summary="")
 
 

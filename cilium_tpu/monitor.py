@@ -18,8 +18,15 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from .datapath.events import DROP_NAMES, TRACE_NAMES
-from .utils.metrics import DROP_COUNT, FORWARD_COUNT
+from .datapath.events import (DROP_NAMES, TIER_NAMES, TRACE_NAMES,
+                              format_denied_key)
+from .utils.metrics import (DROP_COUNT, FORWARD_COUNT,
+                            POLICY_RULE_DROPS, POLICY_VERDICT_TIERS)
+
+# label-cardinality guard: at most this many DISTINCT denied keys are
+# admitted into the per-rule drop counter per ingested batch (the
+# biggest offenders win; the rest still count under drop_count_total)
+MAX_RULE_KEYS_PER_BATCH = 32
 
 
 @dataclass(frozen=True)
@@ -45,6 +52,12 @@ class MonitorEvent:
     # hub-assigned monotonic sequence number (perf-ring cursor analog):
     # pollers resume from ?since=<seq> instead of deduping replays
     seq: int = 0
+    # verdict provenance (0/"" when provenance is disabled): the
+    # decision-tier code (events.TIER_*) and the compiled rule key
+    # that decided — the matched policymap entry, or for drops the
+    # denied query key (events.format_denied_key)
+    tier: int = 0
+    matched_rule: str = ""
 
     @property
     def is_drop(self) -> bool:
@@ -58,9 +71,14 @@ class MonitorEvent:
         name = DROP_NAMES.get(self.code) or TRACE_NAMES.get(self.code) or \
             f"code {self.code}"
         kind = "DROP" if self.is_drop else "TRACE"
+        prov = ""
+        if self.tier:
+            prov = f" tier={TIER_NAMES.get(self.tier, self.tier)}"
+            if self.matched_rule:
+                prov += f" rule={self.matched_rule}"
         return (f"{kind} ep={self.endpoint} identity={self.identity} "
                 f"dport={self.dport} proto={self.proto} "
-                f"len={self.length}: {name}")
+                f"len={self.length}: {name}{prov}")
 
 
 class MonitorHub:
@@ -80,18 +98,31 @@ class MonitorHub:
         self._notify_counts: Dict[str, int] = {}
         # monotonic event cursor; 0 is the "from the beginning" sentinel
         self._next_seq = 1
+        # provenance: cumulative drops per denied/matched rule key
+        # (the "top-dropped rules" surface; fed only when the caller
+        # passes tiers/match_slots from an enable_provenance engine)
+        self._rule_drops: Dict[str, int] = {}
 
     # ------------------------------------------------------------ ingest
 
     def ingest_batch(self, event_codes, endpoints, identities, dports,
-                     protos, lengths) -> None:
-        """Aggregate one datapath batch (all args array-like [B])."""
+                     protos, lengths, tiers=None, match_slots=None,
+                     rule_of=None) -> None:
+        """Aggregate one datapath batch (all args array-like [B]).
+
+        ``tiers``/``match_slots`` are the engine's per-packet
+        provenance outputs (Datapath.last_provenance) and ``rule_of``
+        its slot->string decoder (Datapath.provenance_rule_of): when
+        present, samples carry the decision tier + decided rule,
+        verdicts count by tier, and drops aggregate per denied key."""
         codes = np.asarray(event_codes)
         eps = np.asarray(endpoints)
         ids = np.asarray(identities)
         dps = np.asarray(dports)
         prs = np.asarray(protos)
         lns = np.asarray(lengths)
+        trs = None if tiers is None else np.asarray(tiers)
+        slots = None if match_slots is None else np.asarray(match_slots)
         now = time.time()
 
         uniq, cnt = np.unique(codes, return_counts=True)
@@ -104,6 +135,26 @@ class MonitorHub:
             else:
                 FORWARD_COUNT.inc(n)
 
+        if trs is not None:
+            for tier, n in zip(*map(np.ndarray.tolist,
+                                    np.unique(trs, return_counts=True))):
+                POLICY_VERDICT_TIERS.inc(n, labels={
+                    "tier": TIER_NAMES.get(tier, str(tier))})
+        rule_drops = self._aggregate_rule_drops(codes, ids, dps, prs,
+                                                slots, rule_of) \
+            if trs is not None else {}
+
+        def _rule(i: int) -> str:
+            if trs is None:
+                return ""
+            if slots is not None and int(slots[i]) >= 0 and \
+                    rule_of is not None:
+                return rule_of(int(slots[i]))
+            if int(codes[i]) < 0:
+                return format_denied_key(int(ids[i]), int(dps[i]),
+                                         int(prs[i]))
+            return ""
+
         # bounded sampling: first K drops + first K traces per batch
         samples: List[MonitorEvent] = []
         for want_drop in (True, False):
@@ -113,12 +164,17 @@ class MonitorHub:
                 samples.append(MonitorEvent(
                     timestamp=now, code=int(codes[i]), endpoint=int(eps[i]),
                     identity=int(ids[i]), dport=int(dps[i]),
-                    proto=int(prs[i]), length=int(lns[i])))
+                    proto=int(prs[i]), length=int(lns[i]),
+                    tier=0 if trs is None else int(trs[i]),
+                    matched_rule=_rule(i)))
         with self._lock:
             for code, n in zip(uniq.tolist(), cnt.tolist()):
                 self._counts[code] = self._counts.get(code, 0) + int(n)
                 self._bytes[code] = self._bytes.get(code, 0) + \
                     drop_bytes[code]
+            for rule, n in rule_drops.items():
+                self._rule_drops[rule] = \
+                    self._rule_drops.get(rule, 0) + n
             # stamp the monotonic cursor under the lock (the seq order
             # IS the ring order — pollers resume from it)
             from dataclasses import replace as _replace
@@ -133,6 +189,40 @@ class MonitorHub:
         for fn in subs:
             for ev in samples:
                 fn(ev)
+
+    @staticmethod
+    def _aggregate_rule_drops(codes, ids, dps, prs, slots,
+                              rule_of) -> Dict[str, int]:
+        """Per-rule-key drop totals for one batch: dropped rows group
+        by (identity, dport, proto) — for provenance tiers a drop
+        means NO compiled entry matched, so the denied query key IS
+        the attribution operators need ("who is being denied what").
+        Capped at MAX_RULE_KEYS_PER_BATCH distinct keys (biggest
+        first) so one scan can't explode metric cardinality."""
+        drop_idx = np.flatnonzero(codes < 0)
+        if drop_idx.size == 0:
+            return {}
+        keyed = np.stack([ids[drop_idx].astype(np.int64),
+                          dps[drop_idx].astype(np.int64),
+                          prs[drop_idx].astype(np.int64)], axis=1)
+        uniq, cnt = np.unique(keyed, axis=0, return_counts=True)
+        order = np.argsort(cnt)[::-1][:MAX_RULE_KEYS_PER_BATCH]
+        out: Dict[str, int] = {}
+        for j in order.tolist():
+            rule = format_denied_key(int(uniq[j, 0]), int(uniq[j, 1]),
+                                     int(uniq[j, 2]))
+            out[rule] = int(cnt[j])
+            POLICY_RULE_DROPS.inc(int(cnt[j]), labels={"rule": rule})
+        return out
+
+    def top_dropped_rules(self, n: int = 10) -> List[Dict]:
+        """The denied rule keys dropping the most packets (cumulative
+        since start/reset), largest first."""
+        with self._lock:
+            items = sorted(self._rule_drops.items(),
+                           key=lambda kv: -kv[1])[:n]
+        return [{"rule": rule, "packets": count}
+                for rule, count in items]
 
     def _push(self, ev: MonitorEvent, counter: str) -> None:
         from dataclasses import replace as _replace
@@ -229,6 +319,7 @@ class MonitorHub:
             self._counts = {}
             self._bytes = {}
             self._notify_counts = {}
+            self._rule_drops = {}
             self.lost = 0
 
 
@@ -246,7 +337,8 @@ def _monitor_event_dict(ev: MonitorEvent) -> Dict:
     return {"seq": ev.seq, "timestamp": ev.timestamp, "code": ev.code,
             "endpoint": ev.endpoint, "identity": ev.identity,
             "dport": ev.dport, "proto": ev.proto, "length": ev.length,
-            "kind": ev.kind, "note": ev.note,
+            "kind": ev.kind, "note": ev.note, "tier": ev.tier,
+            "matched_rule": ev.matched_rule,
             "message": ev.describe()}
 
 

@@ -245,6 +245,23 @@ POLICY_IMPORT_ERRORS = registry.counter(
     "policy_import_errors", "Count of failed policy imports")
 POLICY_VERDICTS = registry.counter(
     "policy_verdicts_total", "Datapath verdicts by outcome")
+
+# Verdict provenance series (datapath/events.py TIER_*): which stage
+# of the compiled pipeline decided, which compiled entries are doing
+# the denying, and the drift audit's correctness oracle.
+POLICY_VERDICT_TIERS = registry.counter(
+    "policy_verdicts_by_tier_total",
+    "Datapath verdicts by provenance decision tier")
+POLICY_RULE_DROPS = registry.counter(
+    "policy_rule_drops_total",
+    "Dropped packets by denied policy key (verdict provenance)")
+POLICY_DRIFT = registry.counter(
+    "policy_drift_total",
+    "Drift-audit divergences between the compiled device tables and "
+    "the host policy oracle")
+POLICY_DRIFT_AUDIT_RUNS = registry.counter(
+    "policy_drift_audit_runs_total",
+    "Completed drift-audit sweeps by result")
 PROXY_REDIRECTS = registry.gauge(
     "proxy_redirects", "Number of active proxy redirects")
 PROXY_UPSTREAM_TIME = registry.histogram(

@@ -236,6 +236,20 @@ class DaemonConfig:
     # analog): tables at or above this fill fraction surface warnings
     # in status() / `cilium-tpu status --verbose`
     map_pressure_warn: float = 0.9
+    # verdict provenance (datapath/verdict.py): per-packet matched-rule
+    # attribution + decision tiers emitted by the jitted steps.  Off by
+    # default — the provenance-overhead bench's disabled leg is the
+    # baseline program; replay (`policy trace --replay`) and the drift
+    # audit work either way (they compile their own read-only step)
+    enable_provenance: bool = False
+    # periodic drift audit: replay sampled identity/port tuples through
+    # the LIVE compiled device tables and diff against the host policy
+    # oracles (compute_desired_policy_map_state + SearchContext).
+    # Divergence increments policy_drift_total and fails status()
+    # loudly.  0 disables the controller (run_drift_audit stays
+    # callable on demand).
+    drift_audit_interval_s: float = 30.0
+    drift_audit_samples: int = 64
     kvstore: str = "memory"
     kvstore_opts: Dict[str, str] = field(default_factory=dict)
     # runtime-mutable option map shared by new endpoints

@@ -1,0 +1,87 @@
+"""Event-code lint: named by construction.
+
+A static pass over ``datapath/events.py`` discovered via module
+introspection (no hand-kept list): every DROP_*/TRACE_*/ICMP6_*/TIER_*
+constant must have a human-readable name in its name table, the name
+tables must not carry stale codes, and the Hubble verdict mapping
+(``hubble/flow.verdict_of_event``) must classify every code.  Adding a
+drop reason or trace point without naming it is a test failure, not a
+review nit — `cilium-tpu monitor` and `hubble observe` render these
+names instead of raw codes.
+"""
+
+import cilium_tpu.datapath.events as ev
+from cilium_tpu.hubble.flow import (VERDICT_DROPPED, VERDICT_FORWARDED,
+                                    VERDICT_REDIRECTED, verdict_of_event)
+
+
+def _constants(*prefixes):
+    """Module int constants by name prefix (introspected, not listed)."""
+    return {name: val for name, val in vars(ev).items()
+            if isinstance(val, int) and not isinstance(val, bool)
+            and any(name.startswith(p) for p in prefixes)}
+
+
+def test_every_drop_constant_is_named():
+    drops = _constants("DROP_")
+    unnamed = sorted(n for n, v in drops.items()
+                     if v not in ev.DROP_NAMES)
+    assert not unnamed, f"DROP_* constants missing from DROP_NAMES: " \
+                        f"{unnamed}"
+
+
+def test_every_trace_constant_is_named():
+    # ICMP6_*_REPLY are trace-family terminal actions (the responder
+    # answered); they render through TRACE_NAMES like the TRACE_TO_*s
+    traces = _constants("TRACE_TO_", "ICMP6_")
+    unnamed = sorted(n for n, v in traces.items()
+                     if v not in ev.TRACE_NAMES)
+    assert not unnamed, f"trace constants missing from TRACE_NAMES: " \
+                        f"{unnamed}"
+
+
+def test_every_tier_constant_is_named():
+    tiers = _constants("TIER_")
+    unnamed = sorted(n for n, v in tiers.items()
+                     if v not in ev.TIER_NAMES)
+    assert not unnamed, f"TIER_* constants missing from TIER_NAMES: " \
+                        f"{unnamed}"
+
+
+def test_name_tables_are_not_stale():
+    drops = set(_constants("DROP_").values())
+    traces = set(_constants("TRACE_TO_", "ICMP6_").values())
+    tiers = set(_constants("TIER_").values())
+    assert not set(ev.DROP_NAMES) - drops, \
+        "DROP_NAMES carries codes with no DROP_* constant"
+    assert not set(ev.TRACE_NAMES) - traces, \
+        "TRACE_NAMES carries codes with no trace constant"
+    assert not set(ev.TIER_NAMES) - tiers, \
+        "TIER_NAMES carries codes with no TIER_* constant"
+
+
+def test_no_code_collisions():
+    drops = _constants("DROP_")
+    traces = _constants("TRACE_TO_", "ICMP6_")
+    assert len(set(drops.values())) == len(drops)
+    assert len(set(traces.values())) == len(traces)
+    assert not set(drops.values()) & set(traces.values())
+
+
+def test_event_name_covers_every_code():
+    for val in {**_constants("DROP_"),
+                **_constants("TRACE_TO_", "ICMP6_")}.values():
+        name = ev.event_name(val)
+        assert name and not name.startswith("code "), val
+
+
+def test_verdict_of_event_maps_every_code():
+    """hubble/flow.verdict_of_event must classify every defined code:
+    drops -> DROPPED, the proxy redirect -> REDIRECTED, every other
+    forwarding/trace outcome -> FORWARDED."""
+    for name, val in _constants("DROP_").items():
+        assert verdict_of_event(val) == VERDICT_DROPPED, name
+    for name, val in _constants("TRACE_TO_", "ICMP6_").items():
+        expect = VERDICT_REDIRECTED if val == ev.TRACE_TO_PROXY \
+            else VERDICT_FORWARDED
+        assert verdict_of_event(val) == expect, name

@@ -13,7 +13,8 @@ from cilium_tpu.hubble.aggregation import (FlowTable, aggregate_oracle,
                                            make_flow_state,
                                            snapshot_to_oracle_form)
 from cilium_tpu.hubble.filter import (FlowFilter, parse_drop_reason,
-                                      parse_proto, parse_verdict)
+                                      parse_proto, parse_tier,
+                                      parse_verdict)
 from cilium_tpu.hubble.flow import (FlowRecord, FlowStore,
                                     flow_from_access_log,
                                     flow_from_event, verdict_of_event)
@@ -46,6 +47,9 @@ class TestFilterGrammar:
         ("verdict", "FORWARDED", {}),
         ("drop_reason", "Policy denied (L3/L4)",
          {"verdict": "DROPPED", "drop_reason": "Policy denied (L3/L4)"}),
+        ("tier", "deny",
+         {"verdict": "DROPPED", "tier": "deny",
+          "matched_rule": "deny:identity=256,dport=80,proto=6"}),
         ("l7_protocol", "http", {"l7_protocol": "http"}),
         ("l7_method", "GET", {"l7_protocol": "http",
                               "l7_method": "GET"}),
@@ -60,6 +64,7 @@ class TestFilterGrammar:
         wrong = {"src_identity": 1, "dst_identity": 1, "endpoint": 9,
                  "dport": 81, "proto": 17, "verdict": "DROPPED",
                  "drop_reason": "Prefilter denied",
+                 "tier": "ct-established",
                  "l7_protocol": "dns", "l7_method": "PUT",
                  "l7_status": 200, "node": "other"}
         assert not flt.matches(_flow(**{**flow_kw,
@@ -108,6 +113,35 @@ class TestFilterGrammar:
         q = FlowFilter(since=9, node="n1", dport=80).to_query()
         assert "since" not in q and "node" not in q
         assert q["dport"] == "80"
+
+    def test_tier_filter_forms_and_round_trip(self):
+        # name (case-insensitive) and numeric code both parse
+        from cilium_tpu.datapath.events import TIER_DENY
+        assert parse_tier("DENY") == "deny"
+        assert parse_tier(TIER_DENY) == "deny"
+        assert parse_tier("l7-redirect") == "l7-redirect"
+        with pytest.raises(ValueError):
+            parse_tier("nope")
+        with pytest.raises(ValueError):
+            parse_tier(99)
+        flt = FlowFilter.from_query({"tier": ["L3-ALLOW"]})
+        assert flt.tier == "l3-allow"
+        assert flt.matches(_flow(tier="l3-allow"))
+        assert not flt.matches(_flow(tier="l4-rule"))
+        assert not flt.matches(_flow())  # no provenance -> no match
+        back = FlowFilter.from_query(flt.to_query())
+        assert back == flt
+
+    def test_drop_reason_with_tier_conjunction(self):
+        flt = FlowFilter.from_query({
+            "drop_reason": ["policy denied (l3/l4)"],
+            "tier": ["deny"], "verdict": ["DROPPED"]})
+        hit = _flow(verdict="DROPPED",
+                    drop_reason="Policy denied (L3/L4)", tier="deny")
+        assert flt.matches(hit)
+        assert not flt.matches(_flow(
+            verdict="DROPPED", drop_reason="Policy denied (L3/L4)",
+            tier="ct-established"))
 
     def test_parse_helpers_and_errors(self):
         assert parse_proto("UDP") == 17
@@ -357,6 +391,35 @@ class TestObserver:
         obs.attach_monitor(hub)
         hub.notify_agent("policy-updated", "revision=1")
         assert obs.get_flows(limit=10) == []
+
+    def test_provenance_rides_monitor_events_into_flows(self):
+        """Events ingested with tiers/match_slots become flow records
+        filterable by decision tier, rendered with tier + rule."""
+        from cilium_tpu.datapath.events import (TIER_DENY, TIER_L4_RULE,
+                                                format_denied_key)
+        hub = MonitorHub()
+        obs = FlowObserver(node="nX")
+        obs.attach_monitor(hub)
+        hub.ingest_batch(np.array([-130, 0]), np.array([1, 2]),
+                         np.array([256, 257]), np.array([80, 81]),
+                         np.array([6, 6]), np.array([100, 200]),
+                         tiers=np.array([TIER_DENY, TIER_L4_RULE]),
+                         match_slots=np.array([-1, 5]),
+                         rule_of=lambda s: "identity=257,dport=81,"
+                                           "proto=6,egress")
+        denied = obs.get_flows(FlowFilter(tier="deny"), limit=10)
+        assert len(denied) == 1
+        assert denied[0]["matched_rule"] == \
+            format_denied_key(256, 80, 6)
+        allowed = obs.get_flows(FlowFilter(tier="l4-rule"), limit=10)
+        assert len(allowed) == 1
+        assert allowed[0]["matched_rule"].startswith("identity=257")
+        from cilium_tpu.hubble.flow import flow_from_dict
+        text = flow_from_dict(denied[0]).describe()
+        assert "tier=deny" in text and "rule=deny:" in text
+        # cumulative per-rule drop accounting rides along
+        assert hub.top_dropped_rules()[0]["rule"] == \
+            format_denied_key(256, 80, 6)
 
 
 # ------------------------------------------------------ relay degradation

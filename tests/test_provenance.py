@@ -1,0 +1,495 @@
+"""Verdict provenance: per-packet matched-rule attribution, compiled-
+policy trace replay, and the drift audit.
+
+Covers the acceptance bar of the provenance layer:
+- replay-through-compiled-tables verdicts AND tiers are bit-exact
+  against the host ``oracle_provenance`` on randomized rule sets
+  (3 seeds), and the fused pipeline provenance matches for both
+  address families;
+- the disabled path is unchanged (no provenance outputs, same
+  verdicts);
+- provenance propagates into monitor samples, Hubble flow records,
+  and the tier/rule metrics;
+- an injected compiler corruption is caught by the drift audit in a
+  live daemon, fails status() loudly, and bumps policy_drift_total.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cilium_tpu.compiler.policy_tables import (oracle_provenance,
+                                               oracle_verdict)
+from cilium_tpu.datapath.engine import Datapath, make_full_batch
+from cilium_tpu.datapath.events import (DROP_PREFILTER, TIER_CT_ESTABLISHED,
+                                        TIER_DENY, TIER_L3_ALLOW,
+                                        TIER_L4_RULE, TIER_L7_REDIRECT,
+                                        TIER_LB, TIER_PREFILTER,
+                                        format_denied_key, tier_name)
+from cilium_tpu.policy.mapstate import (EGRESS, INGRESS, PolicyKey,
+                                        PolicyMapState, PolicyMapStateEntry)
+
+
+def random_states(seed, n_endpoints=4, keys_per_ep=24):
+    """Randomized per-endpoint map states mixing every key shape the
+    3-stage lookup distinguishes: exact allows, exact redirects,
+    L3-only keys, and L4-wildcard (identity=0) keys, both dirs."""
+    rng = np.random.default_rng(seed)
+    states = []
+    for _ in range(n_endpoints):
+        st = PolicyMapState()
+        for _k in range(keys_per_ep):
+            kind = rng.integers(0, 4)
+            direction = int(rng.integers(0, 2))
+            ident = int(rng.integers(256, 4096))
+            port = int(rng.integers(1, 65536))
+            proto = int(rng.choice([6, 17]))
+            proxy = int(rng.choice([0, 0, 15000 + int(
+                rng.integers(0, 100))]))
+            if kind == 0:      # exact
+                st[PolicyKey(identity=ident, dest_port=port,
+                             nexthdr=proto, direction=direction)] = \
+                    PolicyMapStateEntry(proxy_port=proxy)
+            elif kind == 1:    # L3-only
+                st[PolicyKey(identity=ident, direction=direction)] = \
+                    PolicyMapStateEntry()
+            elif kind == 2:    # L4-wildcard
+                st[PolicyKey(identity=0, dest_port=port,
+                             nexthdr=proto, direction=direction)] = \
+                    PolicyMapStateEntry(proxy_port=proxy)
+            else:              # exact allow, no proxy
+                st[PolicyKey(identity=ident, dest_port=port,
+                             nexthdr=proto, direction=direction)] = \
+                    PolicyMapStateEntry()
+        states.append(st)
+    return states
+
+
+def sample_tuples(states, seed, n=160):
+    """(ep, identity, dport, proto, dir) probes: half aimed at
+    installed keys (wildcards get random identities), half random."""
+    rng = np.random.default_rng(seed + 1000)
+    rows = []
+    all_keys = [(e, k) for e, st in enumerate(states) for k in st]
+    for _ in range(n // 2):
+        e, k = all_keys[int(rng.integers(0, len(all_keys)))]
+        ident = k.identity or int(rng.integers(256, 1 << 16))
+        rows.append((e, ident, k.dest_port, k.nexthdr, k.direction))
+    for _ in range(n - n // 2):
+        rows.append((int(rng.integers(0, len(states))),
+                     int(rng.integers(0, 1 << 16)),
+                     int(rng.integers(0, 65536)),
+                     int(rng.choice([6, 17])),
+                     int(rng.integers(0, 2))))
+    return rows
+
+
+# ------------------------------------------------------------ replay
+
+
+class TestReplayOracleParity:
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_replay_bit_exact_vs_oracle(self, seed):
+        states = random_states(seed)
+        dp = Datapath(ct_slots=1 << 10)
+        dp.load_policy(states, revision=1, ipcache_prefixes={})
+        rows = sample_tuples(states, seed)
+        out = dp.policy_replay([r[0] for r in rows],
+                               [r[1] for r in rows],
+                               [r[2] for r in rows],
+                               [r[3] for r in rows],
+                               [r[4] for r in rows])
+        for (e, ident, dport, proto, dirc), dev in zip(rows, out):
+            o_verdict, o_tier, o_key = oracle_provenance(
+                states[e], ident, dport, proto, dirc)
+            assert dev["verdict"] == o_verdict, (e, ident, dport)
+            assert dev["tier"] == o_tier, \
+                (dev["tier-name"], tier_name(o_tier), ident, dport)
+            if o_key is None:
+                assert dev["matched"] is None
+            else:
+                m = dev["matched"]
+                assert (m["identity"], m["dport"], m["proto"],
+                        m["direction"]) == (o_key.identity,
+                                            o_key.dest_port,
+                                            o_key.nexthdr,
+                                            o_key.direction)
+                assert m["endpoint-slot"] == e
+
+    def test_replay_verdict_matches_plain_oracle(self):
+        states = random_states(7)
+        dp = Datapath(ct_slots=1 << 10)
+        dp.load_policy(states, revision=1, ipcache_prefixes={})
+        rows = sample_tuples(states, 7, n=64)
+        out = dp.policy_replay([r[0] for r in rows],
+                               [r[1] for r in rows],
+                               [r[2] for r in rows],
+                               [r[3] for r in rows],
+                               [r[4] for r in rows])
+        for (e, ident, dport, proto, dirc), dev in zip(rows, out):
+            assert dev["verdict"] == oracle_verdict(
+                states[e], ident, dport, proto, dirc)
+
+    def test_replay_stage_breakdown(self):
+        st = PolicyMapState()
+        st[PolicyKey(identity=300, dest_port=80, nexthdr=6,
+                     direction=EGRESS)] = PolicyMapStateEntry()
+        st[PolicyKey(identity=300, direction=EGRESS)] = \
+            PolicyMapStateEntry()
+        dp = Datapath(ct_slots=1 << 10)
+        dp.load_policy([st], revision=1, ipcache_prefixes={})
+        row = dp.policy_replay([0], [300], [80], [6], [EGRESS])[0]
+        assert row["stages"]["exact"]["found"]
+        assert row["stages"]["l3"]["found"]
+        assert not row["stages"]["l4_wildcard"]["found"]
+        assert row["tier"] == TIER_L4_RULE  # exact wins the chain
+        # the L3-only key answers when the exact one is absent
+        row = dp.policy_replay([0], [300], [443], [6], [EGRESS])[0]
+        assert row["tier"] == TIER_L3_ALLOW
+        assert row["matched"]["dport"] == 0
+
+
+# --------------------------------------------------- fused pipelines
+
+
+def _v4_datapath(provenance=True):
+    st = PolicyMapState()
+    st[PolicyKey(identity=300, dest_port=80, nexthdr=6,
+                 direction=EGRESS)] = PolicyMapStateEntry()
+    st[PolicyKey(identity=301, direction=EGRESS)] = \
+        PolicyMapStateEntry()
+    st[PolicyKey(identity=0, dest_port=53, nexthdr=17,
+                 direction=EGRESS)] = PolicyMapStateEntry(
+        proxy_port=15001)
+    dp = Datapath(ct_slots=1 << 10)
+    if provenance:
+        dp.enable_provenance()
+    dp.prefilter.insert(["9.9.9.0/24"])
+    dp.load_policy([st], revision=1, ipcache_prefixes={
+        "10.0.0.0/8": 300, "11.0.0.0/8": 301, "12.0.0.0/8": 999})
+    return dp
+
+
+def _v4_batch():
+    return make_full_batch(
+        endpoint=[0] * 5,
+        saddr=["192.168.0.1", "192.168.0.1", "192.168.0.1",
+               "192.168.0.1", "9.9.9.9"],
+        daddr=["10.1.1.1", "11.1.1.1", "12.0.0.1", "12.0.0.2",
+               "10.1.1.1"],
+        sport=[1000] * 5, dport=[80, 443, 53, 9999, 80],
+        proto=[6, 6, 17, 6, 6],
+        # the prefilter row is INGRESS so its saddr is the peer
+        direction=[1, 1, 1, 1, 0])
+
+
+class TestPipelineProvenanceV4:
+    def test_tiers_and_slots(self):
+        dp = _v4_datapath()
+        v, e, i, n = dp.process(_v4_batch(), now=100)
+        prov = dp.last_provenance
+        tiers = np.asarray(prov.tier)
+        slots = np.asarray(prov.match_slot)
+        assert tiers.tolist() == [TIER_L4_RULE, TIER_L3_ALLOW,
+                                  TIER_L7_REDIRECT, TIER_DENY,
+                                  TIER_PREFILTER]
+        assert slots[3] == -1 and slots[4] == -1
+        assert (slots[:3] >= 0).all()
+        assert np.asarray(e)[4] == DROP_PREFILTER
+        # decode names the real compiled keys
+        decode = dp.rule_decoder()
+        assert decode(int(slots[0]))["identity"] == 300
+        assert decode(int(slots[1]))["dport"] == 0
+        assert decode(int(slots[2]))["proxy-port"] == 15001
+
+    def test_established_tier_on_second_batch(self):
+        dp = _v4_datapath()
+        pkt = _v4_batch()
+        dp.process(pkt, now=100)
+        dp.process(pkt, now=101)
+        tiers = np.asarray(dp.last_provenance.tier)
+        # allowed/redirected flows ride their CT entry now; the denied
+        # and prefiltered rows never created one
+        assert tiers.tolist() == [TIER_CT_ESTABLISHED,
+                                  TIER_CT_ESTABLISHED,
+                                  TIER_CT_ESTABLISHED, TIER_DENY,
+                                  TIER_PREFILTER]
+        assert (np.asarray(dp.last_provenance.match_slot)[:3]
+                == -1).all()
+
+    def test_disabled_path_unchanged(self):
+        on = _v4_datapath(provenance=True)
+        off = _v4_datapath(provenance=False)
+        pkt = _v4_batch()
+        v_on, e_on, i_on, _ = on.process(pkt, now=100)
+        v_off, e_off, i_off, _ = off.process(pkt, now=100)
+        assert off.last_provenance is None
+        np.testing.assert_array_equal(np.asarray(v_on),
+                                      np.asarray(v_off))
+        np.testing.assert_array_equal(np.asarray(e_on),
+                                      np.asarray(e_off))
+
+    def test_toggle_reenables_cleanly(self):
+        dp = _v4_datapath(provenance=False)
+        pkt = _v4_batch()
+        dp.process(pkt, now=100)
+        dp.enable_provenance()
+        dp.process(pkt, now=101)
+        assert dp.last_provenance is not None
+        dp.disable_provenance()
+        dp.process(pkt, now=102)
+        assert dp.last_provenance is None
+
+    def test_provenance_with_flow_aggregation(self):
+        """Both optional tails fused at once: the unpack indices must
+        not collide (flows then provenance)."""
+        dp = _v4_datapath()
+        dp.enable_flow_aggregation(slots=1 << 8)
+        pkt = _v4_batch()
+        dp.process(pkt, now=100)
+        assert dp.last_provenance is not None
+        assert np.asarray(dp.last_provenance.tier).shape[0] == 5
+        assert dp.flows.entry_count() > 0
+
+
+class TestPipelineProvenanceV6:
+    def _dp(self):
+        st = PolicyMapState()
+        st[PolicyKey(identity=400, dest_port=443, nexthdr=6,
+                     direction=EGRESS)] = PolicyMapStateEntry()
+        dp = Datapath(ct_slots=1 << 10)
+        dp.enable_provenance()
+        dp.load_policy([st], revision=1, ipcache_prefixes={})
+        dp.load_ipcache6({"fd00::/64": 400})
+        dp.set_router_ip6("fe80::1")
+        return dp
+
+    def test_v6_tiers(self):
+        from cilium_tpu.datapath.engine import make_full_batch6
+        from cilium_tpu.datapath.pipeline import (ICMP6_NS,
+                                                  IPPROTO_ICMPV6)
+        dp = self._dp()
+        pkt = make_full_batch6(
+            endpoint=[0, 0, 0],
+            saddr=["fd00::10", "fd00::10", "fd00::10"],
+            daddr=["fd00::1", "fd00::1", "fe80::9"],
+            sport=[1000] * 3, dport=[443, 9999, 0],
+            proto=[6, 6, IPPROTO_ICMPV6],
+            icmp_type=[0, 0, ICMP6_NS],
+            nd_target=["::", "::", "fe80::1"])
+        v, e, i, n = dp.process6(pkt, now=100)
+        tiers = np.asarray(dp.last_provenance.tier).tolist()
+        assert tiers == [TIER_L4_RULE, TIER_DENY, TIER_LB]
+        slots = np.asarray(dp.last_provenance.match_slot)
+        assert slots[0] >= 0 and slots[1] == -1 and slots[2] == -1
+        # established on replay
+        dp.process6(pkt, now=101)
+        tiers = np.asarray(dp.last_provenance.tier).tolist()
+        assert tiers[0] == TIER_CT_ESTABLISHED
+        assert tiers[1] == TIER_DENY and tiers[2] == TIER_LB
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_v6_new_flow_verdicts_match_oracle(self, seed):
+        """Family parity: a fresh v6 batch's provenance must match
+        the host oracle row by row (policy tables are shared, so the
+        oracle is the same compute_desired-derived state)."""
+        from cilium_tpu.datapath.engine import make_full_batch6
+        states = random_states(seed, n_endpoints=2)
+        dp = Datapath(ct_slots=1 << 10)
+        dp.enable_provenance()
+        dp.load_policy(states, revision=1, ipcache_prefixes={})
+        dp.load_ipcache6({"fd00::/64": 700})
+        rng = np.random.default_rng(seed)
+        n = 64
+        eps = rng.integers(0, 2, n)
+        dports = rng.integers(1, 65536, n)
+        protos = rng.choice([6, 17], n)
+        pkt = make_full_batch6(
+            endpoint=eps, saddr=["fd00::5"] * n, daddr=["fd00::9"] * n,
+            sport=rng.integers(1024, 65535, n), dport=dports,
+            proto=protos, direction=np.ones(n, np.int32))
+        dp.process6(pkt, now=50)
+        tiers = np.asarray(dp.last_provenance.tier)
+        for i in range(n):
+            _v, o_tier, _k = oracle_provenance(
+                states[int(eps[i])], 700, int(dports[i]),
+                int(protos[i]), EGRESS)
+            assert tiers[i] == o_tier, i
+
+
+# ------------------------------------------- monitor/hubble/metrics
+
+
+class TestProvenancePropagation:
+    def _ingest(self, hub, dp, pkt, now=100):
+        v, e, i, n = dp.process(pkt, now=now)
+        prov = dp.last_provenance
+        hub.ingest_batch(np.asarray(e), np.asarray(pkt.endpoint),
+                         np.asarray(i), np.asarray(pkt.dport),
+                         np.asarray(pkt.proto), np.asarray(pkt.length),
+                         tiers=np.asarray(prov.tier),
+                         match_slots=np.asarray(prov.match_slot),
+                         rule_of=dp.provenance_rule_of())
+
+    def test_monitor_samples_carry_tier_and_rule(self):
+        from cilium_tpu.monitor import MonitorHub
+        dp = _v4_datapath()
+        hub = MonitorHub()
+        self._ingest(hub, dp, _v4_batch())
+        events = hub.tail(50)
+        by_code = {ev.code: ev for ev in events}
+        drop = next(ev for ev in events
+                    if ev.is_drop and ev.tier == TIER_DENY)
+        assert drop.matched_rule.startswith("deny:identity=")
+        assert "tier=deny" in drop.describe()
+        assert f"rule={drop.matched_rule}" in drop.describe()
+        allowed = next(ev for ev in events if ev.tier == TIER_L4_RULE)
+        assert allowed.matched_rule.startswith("identity=300")
+        # human-readable reason name, never the raw code
+        assert "Prefilter denied" in by_code[DROP_PREFILTER].describe()
+
+    def test_tier_metric_and_top_dropped_rules(self):
+        from cilium_tpu.monitor import MonitorHub
+        from cilium_tpu.utils.metrics import (POLICY_RULE_DROPS,
+                                              POLICY_VERDICT_TIERS)
+        dp = _v4_datapath()
+        hub = MonitorHub()
+        before = POLICY_VERDICT_TIERS.value(labels={"tier": "deny"})
+        rule = format_denied_key(999, 9999, 6)
+        rule_before = POLICY_RULE_DROPS.value(labels={"rule": rule})
+        self._ingest(hub, dp, _v4_batch())
+        assert POLICY_VERDICT_TIERS.value(
+            labels={"tier": "deny"}) == before + 1
+        assert POLICY_RULE_DROPS.value(
+            labels={"rule": rule}) == rule_before + 1
+        top = hub.top_dropped_rules()
+        assert {"rule": rule, "packets": 1} in top
+
+    def test_flow_records_carry_tier(self):
+        from cilium_tpu.hubble.filter import FlowFilter
+        from cilium_tpu.hubble.observer import FlowObserver
+        from cilium_tpu.monitor import MonitorHub
+        dp = _v4_datapath()
+        hub = MonitorHub()
+        obs = FlowObserver(node="n1", datapath=dp)
+        obs.attach_monitor(hub)
+        self._ingest(hub, dp, _v4_batch())
+        denied = obs.get_flows(FlowFilter.from_query({"tier": ["deny"]}),
+                               limit=50)
+        assert denied and all(f["tier"] == "deny" for f in denied)
+        assert denied[0]["matched_rule"].startswith("deny:")
+        l4 = obs.get_flows(FlowFilter.from_query(
+            {"tier": ["l4-rule"]}), limit=50)
+        assert l4 and l4[0]["matched_rule"].startswith("identity=300")
+
+
+# -------------------------------------------------- drift audit e2e
+
+
+@pytest.fixture
+def live_daemon():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.policy.jsonio import rules_from_json
+    from cilium_tpu.utils.option import DaemonConfig
+    cfg = DaemonConfig(state_dir="", enable_provenance=True,
+                       drift_audit_interval_s=0)
+    d = Daemon(config=cfg)
+    d.endpoint_create(1, ipv4="10.200.0.10", labels=["k8s:id=web"])
+    d.endpoint_create(2, ipv4="10.200.0.11", labels=["k8s:id=db"])
+    rules = rules_from_json(json.dumps([{
+        "endpointSelector": {"matchLabels": {"id": "db"}},
+        "ingress": [{
+            "fromEndpoints": [{"matchLabels": {"id": "web"}}],
+            "toPorts": [{"ports": [{"port": "5432",
+                                    "protocol": "TCP"}]}]}],
+        "labels": ["k8s:policy=t"]}]))
+    rev = d.policy_add(rules)
+    assert d.wait_for_policy_revision(rev, timeout=60)
+    yield d
+    d.shutdown()
+
+
+class TestDriftAudit:
+    def test_clean_tables_pass_and_corruption_is_caught(self,
+                                                        live_daemon):
+        from cilium_tpu.utils.metrics import POLICY_DRIFT
+        d = live_daemon
+        rep = d.run_drift_audit()
+        assert rep["status"] == "ok", rep
+        assert rep["checked"] > 0 and rep["sc-checked"] > 0
+        assert d.status()["provenance"]["drift-audit"]["status"] == "ok"
+
+        # inject a compiler corruption: erase one installed entry from
+        # the DEVICE tensors only (host mirror + realized state intact
+        # — exactly what a buggy table write would look like)
+        drift_before = POLICY_DRIFT.total()
+        mgr = d.table_mgr
+        rows, cols = np.nonzero(mgr._h_key_meta)
+        mgr.key_meta = mgr.key_meta.at[int(rows[0]),
+                                       int(cols[0])].set(0)
+        d.datapath.refresh_policy()
+        rep2 = d.run_drift_audit(samples=256)
+        assert rep2["status"] == "FAILING", rep2
+        assert rep2["divergences"]
+        assert POLICY_DRIFT.total() > drift_before
+        st = d.status()["provenance"]["drift-audit"]
+        assert st["status"] == "FAILING" and st["divergences"] > 0
+
+    def test_replay_rest_and_cli(self, live_daemon, capsys):
+        from cilium_tpu.cli import Client, main as cli_main
+        from cilium_tpu.daemon.rest import APIServer
+        d = live_daemon
+        web = d.endpoints.lookup(1).security_identity
+        srv = APIServer(d).start()
+        try:
+            c = Client(srv.base_url)
+            out = c.post("/policy/trace", {
+                "endpoint": 2, "identity": web, "dport": 5432,
+                "proto": 6, "direction": "ingress"})
+            assert out["device"]["tier-name"] == "l4-rule"
+            assert not out["drift"]
+            assert any(f"PolicyKey(identity={web}, dport=5432" in line
+                       for line in out["explanation"])
+            # denied tuple explains as tier=deny, exit code 1
+            rc = cli_main(["--api", srv.base_url, "policy", "trace",
+                           "--replay", "--endpoint", "2",
+                           "--identity", str(web), "--dport", "80",
+                           "--direction", "ingress"])
+            assert rc == 1
+            text = capsys.readouterr().out
+            assert "tier=deny" in text and "DENIED" in text
+            # allowed tuple via labels resolution, exit code 0
+            rc = cli_main(["--api", srv.base_url, "policy", "trace",
+                           "--replay", "--endpoint", "2", "--src",
+                           "k8s:id=web", "--dport", "5432",
+                           "--direction", "ingress"])
+            assert rc == 0
+            text = capsys.readouterr().out
+            assert "tier=l4-rule" in text and "ALLOWED" in text
+            # unknown endpoint -> 404 surfaces as APIError (exit msg)
+            with pytest.raises(SystemExit):
+                cli_main(["--api", srv.base_url, "policy", "trace",
+                          "--replay", "--endpoint", "99",
+                          "--identity", str(web)])
+            # last replay + drift report land in debuginfo/bugtool
+            info = c.get("/debuginfo")
+            assert info["provenance"]["last-replay"] is not None
+        finally:
+            srv.shutdown()
+
+    def test_drift_report_in_bugtool_archive(self, live_daemon,
+                                             tmp_path):
+        import tarfile
+        from cilium_tpu.bugtool import collect
+        d = live_daemon
+        d.run_drift_audit()
+        path = collect(d, str(tmp_path / "bt.tar.gz"))
+        with tarfile.open(path) as tar:
+            member = next(m for m in tar.getmembers()
+                          if m.name.endswith("provenance.json"))
+            data = json.loads(tar.extractfile(member).read())
+        assert data["enabled"] is True
+        assert data["drift-audit"]["status"] == "ok"
