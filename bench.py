@@ -105,6 +105,26 @@ def _smoke_result():
                                   "strategy": "stride", "k": 3,
                                   "dtype": "int32", "classes": 29,
                                   "states": 96}}}
+    # the latency-tier config's pinned output schema: per-batch-size
+    # sync vs serving p50/p99 plus the coalescing block
+    suite["latency-tier"] = {
+        "metric": "latency_tier_b256_p99_speedup", "value": 6.2,
+        "unit": "x", "vs_baseline": 1.24,
+        "extra": {"smoke": True, "serving_depth": 2,
+                  "under_100us_b256": False,
+                  "per_batch_us": {
+                      "256": {"sync_p50_us": 900.0,
+                              "sync_p99_us": 2400.0,
+                              "serving_p50_us": 300.0,
+                              "serving_p99_us": 390.0,
+                              "serving_interval_us": 310.0,
+                              "p99_speedup": 6.2}},
+                  "coalesce": {"submitters": 16, "frames": 640,
+                               "frame_p99_us": 700.0,
+                               "mean_records_per_launch": 9.0,
+                               "launches": 71,
+                               "sync_b1_p99_us": 1900.0},
+                  "eliminated_boundaries": ["smoke"]}}
     return {"metric": "policy_verdicts_per_sec_config1_100rules",
             "value": 1_290_000, "unit": "verdicts/s",
             "vs_baseline": 0.129,
@@ -222,9 +242,32 @@ def run_bench():
 
     iters = 30 if on_accel else 10
     elapsed, lat = _time_engine(win_iter, iters)
-    vps = iters * batch / elapsed
+    sync_vps = iters * batch / elapsed
     p99_us = float(np.percentile(np.array(lat), 99) * 1e6)
-    _progress("throughput", vps=round(vps),
+
+    # streaming mode: every dispatch in flight before one final sync —
+    # the steady state the serving dispatcher (datapath/serving.py)
+    # actually runs the engine in, where per-dispatch host overhead
+    # overlaps device compute instead of adding to it.  This is the
+    # headline; the per-dispatch sync series above stays in extras.
+    def hash_launch():
+        verdict, _identity, hstate["counters"] = h_step(
+            h_tables, hstate["counters"], pkt)
+        return verdict
+
+    def dense_launch():
+        verdict, _identity, dstate["cpk"], dstate["cby"] = d_step(
+            d_tables, d_lpm, dstate["cpk"], dstate["cby"], *d_args)
+        return verdict
+
+    win_launch = dense_launch if winner == "dense" else hash_launch
+    p_iters = iters * 2
+    jax.block_until_ready([win_launch() for _ in range(2)])  # warm
+    t0 = time.perf_counter()
+    outs = [win_launch() for _ in range(p_iters)]
+    jax.block_until_ready(outs)
+    vps = p_iters * batch / (time.perf_counter() - t0)
+    _progress("throughput", vps=round(vps), sync_vps=round(sync_vps),
               p99_batch_latency_us=round(p99_us, 1))
 
     # ---- small-batch latency: the <50us p99 half of the north star -----
@@ -323,9 +366,12 @@ def run_bench():
                                              330))
     try:
         import bench_suite
-        for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
-                     "capacity", "incremental", "flows-overhead",
-                     "tracing-overhead", "provenance-overhead"):
+        # latency-tier leads: the serving-path latency claim must
+        # never be the config the time budget drops
+        for name in ("latency-tier", "identity-l4", "http-regex",
+                     "kafka-acl", "fqdn", "capacity", "incremental",
+                     "flows-overhead", "tracing-overhead",
+                     "provenance-overhead"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"
                 continue
@@ -351,6 +397,8 @@ def run_bench():
         "unit": "verdicts/s",
         "vs_baseline": round(vps / target, 3),
         "extra": {"batch": batch, "iters": iters, "engine": winner,
+                  "mode": "pipelined",
+                  "sync_vps": round(sync_vps),
                   "p99_batch_latency_us": round(p99_us, 1),
                   "hash_probe_vps": round(probe_iters * batch / h_probe),
                   "dense_probe_vps": round(probe_iters * batch / d_probe),

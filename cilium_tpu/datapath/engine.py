@@ -33,7 +33,7 @@ from .lb import (CompiledLB, CompiledLB6, LoadBalancer, Service,
 from .pipeline import (DatapathTables, FullPacketBatch, FullPacketBatch6,
                        FullTables, FullTables6, build_tables,
                        full_datapath_step, full_datapath_step6,
-                       lpm6_tables)
+                       full_datapath_step_packed, lpm6_tables)
 from .events import format_rule
 from .prefilter import PreFilter
 from .verdict import Counters, Provenance, _explain_jit, make_packet_batch
@@ -82,6 +82,8 @@ class Datapath:
         self.counters: Optional[Counters] = None
         self.revision = 0
         self._step = None
+        self._step_packed = None
+        self._step_packed_nc = None
         self._tables: Optional[FullTables] = None
         self._step6 = None
         self._tables6: Optional[FullTables6] = None
@@ -104,7 +106,17 @@ class Datapath:
         self.telemetry_enabled = True
         self.on_revision_served = None  # callable(revision)
         self._served_revision = 0
+        # deferred verdict-outcome accounting has its OWN lock: the
+        # force-flush can block on a device transfer, and that must
+        # never happen while holding the device dispatch lock
+        self._verdict_lock = threading.Lock()
         self._pending_verdicts: List = []
+        # per-second device timestamp cache: steady-state dispatch
+        # reuses the same jnp scalar instead of a fresh H2D per batch
+        self._ts_cache: Optional[Tuple[int, object]] = None
+        # the shared continuous micro-batching dispatcher
+        # (datapath/serving.py), created on first use
+        self._serving = None
         # verdict provenance (datapath/verdict.py Provenance): when
         # enabled, both family steps additionally emit the matched
         # policymap slot + decision tier per packet; the last batch's
@@ -461,6 +473,15 @@ class Datapath:
             functools.partial(full_datapath_step, **v4_static,
                               **flow_kwargs, flow_claim_budget=0),
             donate_argnums=(1, 2))
+        # the serving path's packed twins: same program over a single
+        # [10, B] field matrix (one H2D per batch instead of ten)
+        self._step_packed = jax.jit(functools.partial(
+            full_datapath_step_packed, **v4_static, **flow_kwargs),
+            donate_argnums=(1, 2))
+        self._step_packed_nc = None if self.flows is None else jax.jit(
+            functools.partial(full_datapath_step_packed, **v4_static,
+                              **flow_kwargs, flow_claim_budget=0),
+            donate_argnums=(1, 2))
 
         # v6 twin: shares the (family-agnostic) policy tensors, runs
         # the 4-word LPMs for prefilter/ipcache and its own CT table.
@@ -501,16 +522,34 @@ class Datapath:
             return step
         return step_nc
 
+    def _timestamp(self, now: Optional[int]):
+        """Device scalar for the batch timestamp, cached per value:
+        wall-clock `now` changes once a second, so steady-state
+        dispatch reuses one device scalar instead of paying a fresh
+        H2D transfer (and allocation) per batch."""
+        val = int(now if now is not None else time.time())
+        cache = self._ts_cache
+        if cache is not None and cache[0] == val:
+            return cache[1]
+        ts = jnp.int32(val)
+        self._ts_cache = (val, ts)
+        return ts
+
     def process(self, pkt: FullPacketBatch, now: Optional[int] = None):
         """Classify a batch. Returns (verdict, event, identity, nat) —
-        nat carries the DNAT'd forward tuple and rev-NAT'd reply tuple."""
+        nat carries the DNAT'd forward tuple and rev-NAT'd reply tuple.
+
+        Dispatch is asynchronous: the returned arrays are in-flight
+        device values; nothing here blocks on device compute, and the
+        engine lock covers ONLY the dispatch + state swap (timestamp
+        upload happens before it, telemetry accounting after)."""
         telem = self.telemetry_enabled
         t0 = time.perf_counter() if telem else 0.0
+        ts = self._timestamp(now)
         with self._lock:
             if self._step is None:
                 raise RuntimeError("no policy loaded")
             t_lock = time.perf_counter() if telem else 0.0
-            ts = jnp.int32(now if now is not None else int(time.time()))
             if self.flows is not None:
                 step = self._flow_step_variant(self._step,
                                                self._step_nc)
@@ -529,11 +568,11 @@ class Datapath:
             if self.provenance_enabled:
                 self.last_provenance = Provenance(outs[tail],
                                                   outs[tail + 1])
-            if telem:
-                self._account_dispatch("engine-v4", "datapath.process",
-                                       step, pkt.endpoint.shape[0],
-                                       t0, t_lock, verdict)
             served = self._revision_newly_served_locked()
+        if telem:
+            self._account_dispatch("engine-v4", "datapath.process",
+                                   step, pkt.endpoint.shape[0],
+                                   t0, t_lock, verdict)
         if served:
             self._notify_revision_served(served)
         return verdict, event, identity, nat
@@ -541,14 +580,15 @@ class Datapath:
     def process6(self, pkt: FullPacketBatch6,
                  now: Optional[int] = None):
         """Classify a v6 batch (bpf_lxc.c:745 ipv6_policy path).
-        Returns (verdict, event, identity, nat6)."""
+        Returns (verdict, event, identity, nat6).  Same async-dispatch
+        and narrow-lock contract as process()."""
         telem = self.telemetry_enabled
         t0 = time.perf_counter() if telem else 0.0
+        ts = self._timestamp(now)
         with self._lock:
             if self._step6 is None:
                 raise RuntimeError("no policy loaded")
             t_lock = time.perf_counter() if telem else 0.0
-            ts = jnp.int32(now if now is not None else int(time.time()))
             if self.flows is not None:
                 step = self._flow_step_variant(self._step6,
                                                self._step6_nc)
@@ -567,14 +607,69 @@ class Datapath:
             if self.provenance_enabled:
                 self.last_provenance = Provenance(outs[tail],
                                                   outs[tail + 1])
-            if telem:
-                self._account_dispatch("engine-v6", "datapath.process6",
-                                       step, pkt.endpoint.shape[0],
-                                       t0, t_lock, verdict)
             served = self._revision_newly_served_locked()
+        if telem:
+            self._account_dispatch("engine-v6", "datapath.process6",
+                                   step, pkt.endpoint.shape[0],
+                                   t0, t_lock, verdict)
         if served:
             self._notify_revision_served(served)
         return verdict, event, identity, nat
+
+    def process_packed(self, packed, now: Optional[int] = None):
+        """Classify a v4 batch given as ONE [10, B] int32 field matrix
+        (pipeline.PACKED_FIELDS order) — the serving dispatcher's hot
+        entry: a single H2D transfer per batch instead of ten, with
+        the per-field unpack fused into the compiled program.  Same
+        verdict/event/identity/nat outputs, same async-dispatch and
+        narrow-lock contract as process()."""
+        telem = self.telemetry_enabled
+        t0 = time.perf_counter() if telem else 0.0
+        ts = self._timestamp(now)
+        with self._lock:
+            if self._step_packed is None:
+                raise RuntimeError("no policy loaded")
+            t_lock = time.perf_counter() if telem else 0.0
+            if self.flows is not None:
+                step = self._flow_step_variant(self._step_packed,
+                                               self._step_packed_nc)
+                outs = step(self._tables, self.ct.state, self.counters,
+                            packed, ts, self.flows.state)
+            else:
+                step = self._step_packed
+                outs = step(self._tables, self.ct.state, self.counters,
+                            packed, ts)
+            verdict, event, identity, nat = outs[:4]
+            self.ct.state, self.counters = outs[4], outs[5]
+            tail = 6
+            if self.flows is not None:
+                self.flows.state = outs[tail]
+                tail += 1
+            if self.provenance_enabled:
+                self.last_provenance = Provenance(outs[tail],
+                                                  outs[tail + 1])
+            served = self._revision_newly_served_locked()
+        if telem:
+            self._account_dispatch("engine-v4", "datapath.process",
+                                   step, int(packed.shape[1]),
+                                   t0, t_lock, verdict)
+        if served:
+            self._notify_revision_served(served)
+        return verdict, event, identity, nat
+
+    # -- the latency-tier serving path (datapath/serving.py) -----------------
+
+    def serving(self):
+        """THE shared continuous micro-batching dispatcher for this
+        engine (created on first use): the verdict service, L7 plane
+        and direct callers submit record chunks here so concurrent
+        endpoints coalesce into one device launch instead of
+        serializing pack+dispatch+sync on the engine lock."""
+        with self._lock:
+            if self._serving is None:
+                from .serving import VerdictDispatcher
+                self._serving = VerdictDispatcher(self)
+            return self._serving
 
     # -- self-telemetry (observability/) -------------------------------------
 
@@ -582,7 +677,9 @@ class Datapath:
                           batch: int, t0: float, t_lock: float,
                           verdict) -> None:
         """Stage slices + jit-cache classification + deferred
-        verdict-outcome accounting for one dispatch (lock held)."""
+        verdict-outcome accounting for one dispatch.  Runs AFTER the
+        engine lock is released — accounting (and the occasional
+        force-flush device read) must never extend the lock hold."""
         t_done = time.perf_counter()
         record_stage(family, "lock-wait", t_lock - t0)
         record_stage(family, "dispatch", t_done - t_lock)
@@ -590,15 +687,17 @@ class Datapath:
         # XLA compile synchronously inside the dispatch slice
         jit_telemetry.record(entry, id(step), int(batch),
                              t_done - t_lock)
-        self._pending_verdicts.append(verdict)
-        self._flush_verdict_counts(
-            force=len(self._pending_verdicts) > 8)
+        with self._verdict_lock:
+            self._pending_verdicts.append(verdict)
+            self._flush_verdict_counts(
+                force=len(self._pending_verdicts) > 8)
 
     def _flush_verdict_counts(self, force: bool = False) -> None:
-        """Count verdict outcomes from completed batches (lock held).
-        Dispatch is async, so the just-dispatched batch is usually not
-        ready — it gets counted on a later call (or force-synced once
-        the pending window fills), never blocking the hot path."""
+        """Count verdict outcomes from completed batches (verdict lock
+        held).  Dispatch is async, so the just-dispatched batch is
+        usually not ready — it gets counted on a later call (or
+        force-synced once the pending window fills), never blocking
+        the hot path."""
         remaining = []
         for arr in self._pending_verdicts:
             ready = force
@@ -612,7 +711,7 @@ class Datapath:
                 remaining.append(arr)
                 continue
             try:
-                v = np.asarray(arr)
+                v = np.asarray(arr)  # sync-ok: is_ready-gated (or a bounded force-flush outside the device lock)
             except Exception:  # noqa: BLE001 — deleted buffer
                 continue
             denied = int((v < 0).sum())
@@ -630,8 +729,9 @@ class Datapath:
         self._pending_verdicts = remaining
 
     def flush_telemetry(self) -> None:
-        """Drain deferred verdict accounting (metrics-scrape path)."""
-        with self._lock:
+        """Drain deferred verdict accounting (metrics-scrape path).
+        Takes only the verdict lock — a scrape never stalls dispatch."""
+        with self._verdict_lock:
             self._flush_verdict_counts(force=True)
 
     def _revision_newly_served_locked(self) -> int:

@@ -199,6 +199,31 @@ def _flow_identities(ep_identity, endpoint, peer_identity, direction):
     return src, dst
 
 
+# field order of the serving path's packed [10, B] batch matrix
+# (datapath/serving.py staging buffers; full_datapath_step_packed
+# unpacks in this exact order inside the fused program)
+PACKED_FIELDS = ("endpoint", "saddr", "daddr", "sport", "dport",
+                 "proto", "direction", "tcp_flags", "length",
+                 "is_fragment")
+
+
+def full_datapath_step_packed(tables: FullTables, ct,
+                              counters: Counters, packed, now,
+                              flows=None, **statics):
+    """full_datapath_step over ONE [10, B] int32 field matrix.
+
+    The latency-tier fix for small-batch dispatch overhead: ten
+    per-field host->device transfers (each paying a full dispatch,
+    ~80 us apiece on the CPU backend — batch-size independent)
+    collapse into a single H2D of the packed matrix; the per-field
+    unpack is row slicing INSIDE the jitted program, which XLA fuses
+    away.  Field order is PACKED_FIELDS."""
+    pkt = FullPacketBatch(**{f: packed[i]
+                             for i, f in enumerate(PACKED_FIELDS)})
+    return full_datapath_step(tables, ct, counters, pkt, now,
+                              flows, **statics)
+
+
 def full_datapath_step(tables: FullTables, ct, counters: Counters,
                        pkt: FullPacketBatch, now: jnp.ndarray,
                        flows=None, *,

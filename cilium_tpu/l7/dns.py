@@ -161,6 +161,26 @@ class DNSPolicyEngine:
                            np.zeros(n, bool))
         return out
 
+    def dispatch_split(self):
+        """(dispatch, finalize) pair for the shared serving core
+        (l7/parser.VerdictBatcher): dispatch encodes + launches the
+        selector match asynchronously, finalize syncs and reduces to
+        per-name allow booleans.  None when selectorless."""
+        if self._compiled is None:
+            return None
+
+        def dispatch(names):
+            return self.match_device(self.encode_packed(names)), \
+                len(names)
+
+        def finalize(handle, n):
+            dev, real = handle
+            hits = np.asarray(dev)[:real]
+            return hits.any(axis=1) if hits.shape[1] else \
+                np.zeros(real, bool)
+
+        return dispatch, finalize
+
     def engine_report(self) -> Optional[dict]:
         """Engine-selection report (bench extras / status)."""
         return None if self._compiled is None \

@@ -237,6 +237,26 @@ class HTTPPolicyEngine:
         return [np.ones(n, bool) if dev is None else
                 np.asarray(dev)[:n] for dev, n in inflight]
 
+    def dispatch_split(self):
+        """(dispatch, finalize) pair for the shared serving core
+        (l7/parser.VerdictBatcher): ``dispatch(requests)`` encodes and
+        launches the device match with NO synchronization;
+        ``finalize(handle, n)`` performs the one blocking transfer and
+        returns the [n] bool verdicts.  None for allow-all engines —
+        they have no device program to overlap."""
+        if self._combined is None:
+            return None
+
+        def dispatch(requests):
+            data, hdata = self.encode_packed(requests)
+            return self.match_device(data, hdata), len(requests)
+
+        def finalize(handle, n):
+            dev, real = handle
+            return np.asarray(dev)[:real]
+
+        return dispatch, finalize
+
     def engine_report(self) -> Optional[dict]:
         """Engine-selection report (bench extras / status): which
         strategy/k/dtype each compiled table runs with."""

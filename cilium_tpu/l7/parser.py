@@ -169,66 +169,95 @@ class Instance:
 
 class VerdictBatcher:
     """Micro-batches concurrent per-frame policy checks into batched
-    engine dispatches — the live-proxy batch path.
+    engine dispatches — the live-proxy batch path, now an asyncio
+    facade over the SHARED continuous micro-batching core
+    (datapath/serving.ContinuousDispatcher), the same machinery the
+    verdict service and direct engine callers dispatch through.
 
-    A proxy serving many connections issues one ``check_one`` per frame,
-    paying a full device round trip each; this collects frames that
-    arrive within a short window (plus everything that queues while a
-    batch is in flight) into one batched engine call.  The engine call
-    runs in an executor thread, so the event loop keeps accepting and
-    buffering the NEXT window while the current batch computes — the
-    double-buffered host-encode/device-match overlap, at the proxy
-    tier.
+    A proxy serving many connections issues one ``check_one`` per
+    frame, paying a full device round trip each; this coalesces frames
+    that arrive within a short window (plus everything that queues
+    while a batch is in flight) into one batched engine call on the
+    core's dispatcher thread, so the event loop keeps accepting and
+    buffering the NEXT window while the current batch computes.
 
     ``check_batch`` is any Sequence[item] -> Sequence[bool] (e.g.
-    ``HTTPPolicyEngine.check``).  Failures fail closed: every frame in
-    a batch whose dispatch raised is denied.
+    ``HTTPPolicyEngine.check``).  Engines that expose
+    ``dispatch_split()`` (HTTP/DNS) go further: ``dispatch_split=
+    (dispatch, finalize)`` launches the device match with NO sync at
+    dispatch time and defers the one blocking transfer to the core's
+    *complete* stage — host encode of window N+1 overlaps window N's
+    device walk (the l7/http.py ``check_pipelined`` overlap, run
+    continuously).  Failures fail closed: every frame in a batch whose
+    dispatch or completion raised is denied — the guarantee the shared
+    dispatcher extends to every serving caller.
     """
 
     def __init__(self, check_batch: Callable[[Sequence], Sequence],
-                 max_batch: int = 512, max_wait: float = 0.001):
+                 max_batch: int = 512, max_wait: float = 0.001,
+                 dispatch_split: "Optional[Tuple[Callable, Callable]]"
+                 = None, name: str = "l7"):
+        from ..datapath.serving import ContinuousDispatcher
         self.check_batch = check_batch
         self.max_batch = max_batch
         self.max_wait = max_wait
-        self._pending: List[Tuple[object, asyncio.Future]] = []
-        self._flusher: Optional[asyncio.Task] = None
-        # observability: how well the batching is working
-        self.batches = 0
-        self.checked = 0
-        self.max_batch_seen = 0
-        self.errors = 0
+        if dispatch_split is not None:
+            dispatch_fn, finalize_fn = dispatch_split
+
+            def launch(items, total):
+                return dispatch_fn(items)   # async device dispatch
+
+            def finalize(handle, weights):
+                return [bool(v)
+                        for v in finalize_fn(handle, len(weights))]
+        else:
+            def launch(items, total):
+                return items                # host handle; work below
+
+            def finalize(handle, weights):
+                return [bool(v) for v in self.check_batch(handle)]
+
+        self._core = ContinuousDispatcher(
+            launch, finalize, deny=lambda item: False,
+            max_batch=max_batch, window=max_wait, lane=name)
 
     async def check(self, item) -> bool:
-        """Queue one frame; resolves with its verdict."""
+        """Queue one frame; resolves with its verdict (False on a
+        failed batch — fail closed)."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((item, fut))
-        if self._flusher is None or self._flusher.done():
-            self._flusher = loop.create_task(self._drain())
+        ticket = self._core.submit(item)
+
+        def _resolved(t, _loop=loop, _fut=fut):
+            _loop.call_soon_threadsafe(self._deliver, _fut, t)
+
+        ticket.add_done_callback(_resolved)
         return await fut
 
-    async def _drain(self) -> None:
-        # collection window: frames from other connections pile in
-        await asyncio.sleep(self.max_wait)
-        loop = asyncio.get_running_loop()
-        while self._pending:
-            batch = self._pending[:self.max_batch]
-            self._pending = self._pending[len(batch):]
-            items = [it for it, _ in batch]
-            try:
-                # executor thread: the loop collects the next window
-                # while this batch encodes + matches
-                verdicts = await loop.run_in_executor(
-                    None, self.check_batch, items)
-            except Exception:  # noqa: BLE001 — fail closed per frame
-                self.errors += 1
-                verdicts = [False] * len(items)
-            self.batches += 1
-            self.checked += len(items)
-            self.max_batch_seen = max(self.max_batch_seen, len(items))
-            for (_, fut), v in zip(batch, verdicts):
-                if not fut.done():
-                    fut.set_result(bool(v))
+    @staticmethod
+    def _deliver(fut: asyncio.Future, ticket) -> None:
+        if not fut.done():
+            fut.set_result(bool(ticket.value))
+
+    # observability passthrough (the pre-merge counter names)
+    @property
+    def batches(self) -> int:
+        return self._core.batches
+
+    @property
+    def checked(self) -> int:
+        return self._core.items_total
+
+    @property
+    def max_batch_seen(self) -> int:
+        return self._core.max_batch_seen
+
+    @property
+    def errors(self) -> int:
+        return self._core.errors
+
+    def close(self) -> None:
+        self._core.close()
 
     def stats(self) -> Dict:
         return {"batches": self.batches, "checked": self.checked,

@@ -482,8 +482,14 @@ class SocketProxy:
         if ent is None:
             def check_batch(reqs):
                 return list(engine.check(reqs))
+            # engines with a device program hand the batcher their
+            # dispatch/finalize split, so the serving core overlaps
+            # host encode with the in-flight device match
+            split = engine.dispatch_split() \
+                if hasattr(engine, "dispatch_split") else None
             ent = (engine, VerdictBatcher(
-                check_batch, max_wait=self.http_batch_window))
+                check_batch, max_wait=self.http_batch_window,
+                dispatch_split=split, name="http-proxy"))
             self._http_batchers[id(engine)] = ent
         return ent[1]
 

@@ -59,8 +59,11 @@ _stats: Dict[str, Dict[str, _StageStat]] = {}
 # blocking boundaries: stages whose wall time is device compute the
 # host waited out, not host work — pipeline_report flags them so an
 # operator reads "sync is 90% of the budget" as device-bound, not as
-# a host regression
-BLOCKING_STAGES = frozenset({"sync", "block", "device-sync"})
+# a host regression.  "complete" is the serving dispatcher's ticket
+# resolution (datapath/serving.py) — the ONE whitelisted sync on the
+# latency-tier path, always one batch behind the launch front.
+BLOCKING_STAGES = frozenset({"sync", "block", "device-sync",
+                             "complete"})
 
 
 def record_stage(family: str, stage: str, seconds: float) -> None:

@@ -112,14 +112,13 @@ def bucket_cols(data: "np.ndarray", min_cols: int = 16) -> "np.ndarray":
     encode_strings keep their poison in any column slice).  Power-of-two
     widths bound the jit program cache exactly like bucket_rows."""
     import numpy as np
+    from ..utils.bucketing import bucket_size
     b, full = data.shape
     if b == 0 or full <= min_cols:
         return data
     used = np.nonzero((data >= 0).any(axis=0))[0]
     eff = int(used[-1]) + 1 if used.size else 1
-    cols = min_cols
-    while cols < eff:
-        cols *= 2
+    cols = bucket_size(eff, min_cols)
     if cols >= full:
         return data
     return np.ascontiguousarray(data[:, :cols])
@@ -134,10 +133,9 @@ def bucket_rows(data: "np.ndarray", min_rows: int = 16) -> "np.ndarray":
     cache to O(log B_max) entries.  Pad rows are -1 (pure padding:
     states freeze at start, and callers slice the result back)."""
     import numpy as np
+    from ..utils.bucketing import bucket_size
     b = data.shape[0]
-    rows = min_rows
-    while rows < b:
-        rows *= 2
+    rows = bucket_size(b, min_rows)
     if rows == b:
         return data
     out = np.full((rows, data.shape[1]), -1, data.dtype)

@@ -1,0 +1,29 @@
+"""Shared power-of-two batch-bucket selection.
+
+Every jitted entry point pays a fresh XLA trace+compile per distinct
+batch geometry, so live callers (proxies, the verdict service, the
+shared serving dispatcher) round batch sizes up to a power-of-two
+bucket with a minimum floor: the jit program cache stays bounded at
+O(log B_max) entries per program.
+
+This is THE bucket function — the verdict service's frame padding, the
+DFA row bucketing (ops/dfa_ops.bucket_rows) and the latency-tier
+serving path (datapath/serving.py) all call it, so bucket boundaries
+can never drift between tiers (tests/test_serving.py pins them).
+"""
+
+from __future__ import annotations
+
+MIN_ROWS = 16
+
+
+def bucket_size(n: int, min_rows: int = MIN_ROWS) -> int:
+    """Smallest power-of-two multiple of ``min_rows``'s bucket ladder
+    covering ``n``: max(min_rows, next_pow2(n)).  ``min_rows`` itself
+    must be a power of two (asserted — a non-pow2 floor would mint a
+    parallel bucket ladder and unbound the jit cache)."""
+    assert min_rows > 0 and (min_rows & (min_rows - 1)) == 0, min_rows
+    rows = min_rows
+    while rows < n:
+        rows *= 2
+    return rows

@@ -11,17 +11,24 @@ is the proxy_port redirect into Envoy; here the redirect target is a
 batch RPC.
 
 Architecture per connection (two-tier ingest, reusing the native
-runtime):
+runtime), feeding the SHARED latency-tier dispatcher:
 
-  reader thread --> C++ SPSC PacketRing --> dispatcher thread --> TPU
-   (socket recv,      (native/runtime.cc,     (drains up to
-    raw records        lock-free, SoA          max_batch records,
-    pushed as           drain)                 pads to a pow2 bucket,
-    received)                                  ONE device dispatch)
+  reader thread --> C++ SPSC PacketRing --> drain thread --> shared
+   (socket recv,      (native/runtime.cc,     (drains up to   serving
+    raw records        lock-free, SoA          max_batch,     dispatcher
+    pushed as           drain)                 submits a      (datapath/
+    received)                                  ticket, keeps   serving.py)
+                                               2 in flight)
 
 Small frames from chatty clients coalesce in the ring, so the device
 sees large batches regardless of client write sizes; responses are
-returned per frame, in order (SPSC preserves FIFO).
+returned per frame, in order (SPSC preserves FIFO, and serving tickets
+resolve in submission order).  Device work goes through the engine's
+continuous micro-batching dispatcher, so concurrent connections — and
+any other caller of the serving path — coalesce into one device launch
+with async double-buffered dispatch; each connection additionally
+keeps up to two tickets outstanding so its own pack/response work
+overlaps device compute.
 
 Wire protocol — 12-byte headers are big-endian; the record payload is
 the native PKT_HEADER_DTYPE layout (LITTLE-endian fields, 24B/record,
@@ -46,10 +53,11 @@ import struct
 import threading
 import time
 from collections import deque
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
+from .utils.bucketing import bucket_size as _bucket  # shared ladder
 from .utils.netio import recv_exact as _recv_exact
 from .utils.netio import recv_exact_within as _recv_exact_within
 
@@ -59,22 +67,22 @@ MAGIC_AUTH = 0xC111A9A1     # server challenge frame
 MAGIC_AUTH_OK = 0xC111A9A2  # server accept frame
 MAX_COUNT = 1 << 20
 
+# per-connection ticket pipeline depth: how many serving tickets a
+# connection keeps outstanding before blocking on the oldest — matches
+# the serving dispatcher's double-buffer depth
+PIPELINE_DEPTH = 2
+
 
 class VerdictServiceError(RuntimeError):
     pass
 
 
-def _bucket(n: int, min_rows: int = 16) -> int:
-    rows = min_rows
-    while rows < n:
-        rows *= 2
-    return rows
-
-
 class VerdictService:
-    """Serves a Datapath over TCP (one ring + dispatcher per
-    connection; the daemon's device lock serializes actual device
-    dispatch)."""
+    """Serves a Datapath over TCP: one ring + drain thread per
+    connection, all submitting into the engine's shared continuous
+    micro-batching dispatcher (datapath/serving.py) so concurrent
+    connections share device launches instead of serializing on the
+    engine lock."""
 
     def __init__(self, datapath, host: str = "127.0.0.1", port: int = 0,
                  max_batch: int = 1 << 15,
@@ -107,8 +115,20 @@ class VerdictService:
         self.handshake_timeout = handshake_timeout
         self.frame_timeout = frame_timeout
         self.frames_served = 0
-        self.batches_dispatched = 0
-        self._stats_lock = threading.Lock()  # one dispatcher per conn
+        self._stats_lock = threading.Lock()  # one drain thread per conn
+        # device work goes through the engine's SHARED serving
+        # dispatcher (all callers coalesce) unless this service wants
+        # smaller device batches than the shared lane allows — then it
+        # runs a private lane at its own max_batch
+        shared = datapath.serving() if hasattr(datapath, "serving") \
+            else None
+        if shared is not None and max_batch >= shared.max_batch:
+            self._dispatcher = shared
+        else:
+            from .datapath.serving import VerdictDispatcher
+            self._dispatcher = VerdictDispatcher(
+                datapath, max_batch=max_batch, lane="verdict-service")
+        self._batches_base = self._dispatcher.batches
         svc = self
 
         class _Conn(socketserver.BaseRequestHandler):
@@ -168,11 +188,38 @@ class VerdictService:
         dead = threading.Event()  # dispatcher exited (error or EOF)
 
         def dispatcher():
+            # (ticket, covers): covers maps the submitted records back
+            # to wire frames — computed at submit time (coverage is
+            # independent of verdict values), resolved at completion.
+            # Up to PIPELINE_DEPTH tickets stay outstanding so this
+            # connection's drain+submit of batch N+1 overlaps batch
+            # N's device walk — the per-connection double buffer on
+            # top of the shared dispatcher's own.
+            inflight: "deque[Tuple[object, list]]" = deque()
+
+            def complete_one():
+                ticket, covers = inflight.popleft()
+                verdicts, idents = ticket.result()
+                if ticket.error is not None:
+                    # the serving tier failed closed (those frames are
+                    # denials); this service's contract is stronger:
+                    # drop the connection so the client fails fast
+                    raise VerdictServiceError(
+                        f"serving dispatch failed: {ticket.error!r}")
+                for fid, s, e, partial in covers:
+                    item = (fid, verdicts[s:e], idents[s:e])
+                    self._send_resp(sock,
+                                    item + (True,) if partial else item,
+                                    partials)
+
             try:
                 while True:
                     with frames_lock:
                         have = len(frames) > 0
                     if not have:
+                        if inflight:
+                            complete_one()
+                            continue
                         if eof.is_set():
                             return
                         wake.wait(0.05)
@@ -180,28 +227,33 @@ class VerdictService:
                         continue
                     soa, n = ring.pop_batch(self.max_batch)
                     if n == 0:
+                        if inflight:
+                            complete_one()
+                            continue
                         wake.wait(0.005)
                         wake.clear()
                         continue
-                    verdicts, idents = self._classify(soa, n)
-                    # answer every complete frame covered by this drain
+                    # frame coverage of this drain, claimed up front
+                    covers = []
                     off = 0
-                    out = []
                     with frames_lock:
                         while frames and off + frames[0][1] <= n:
                             fid, cnt = frames.popleft()
-                            out.append((fid, verdicts[off:off + cnt],
-                                        idents[off:off + cnt]))
+                            covers.append((fid, off, off + cnt, False))
                             off += cnt
                         if off != n:
                             # drain split a frame: its tail is still in
                             # the ring; stash the head
                             fid, cnt = frames.popleft()
                             frames.appendleft((fid, cnt - (n - off)))
-                            out.append((fid, verdicts[off:n],
-                                        idents[off:n], True))
-                    for item in out:
-                        self._send_resp(sock, item, partials)
+                            covers.append((fid, off, n, True))
+                    # pop_batch returned fresh arrays — safe to hand
+                    # to the dispatcher thread without copying
+                    inflight.append(
+                        (self._dispatcher.submit_records(soa, n),
+                         covers))
+                    while len(inflight) >= PIPELINE_DEPTH:
+                        complete_one()
             except Exception:  # noqa: BLE001 — send failure or e.g.
                 # "no policy loaded" mid-recompile: a dead dispatcher
                 # must not leave the client hanging until its timeout
@@ -275,58 +327,18 @@ class VerdictService:
             self.frames_served += 1  # may read the counter on response
         sock.sendall(payload)
 
-    # -------------------------------------------------------- classify
-
-    def _classify(self, soa, n: int) -> Tuple[np.ndarray, np.ndarray]:
-        """One device dispatch for n drained records (padded to a
-        power-of-two bucket; pad rows duplicate row 0 so no new
-        conntrack keys appear).  Each host stage (pack, dispatch,
-        device sync) is timed into the pipeline-stage histograms and
-        the batch runs under a tracer span — the verdict-service leg
-        of the daemon -> TPU trace (~0 cost when telemetry is off)."""
-        from .datapath.engine import make_full_batch
-        from .observability.stages import record_stage
-        from .observability.tracer import tracer
-        telem = getattr(self.datapath, "telemetry_enabled", False)
-        rows = _bucket(n)
-
-        def pad(a):
-            out = np.empty(rows, np.int32)
-            out[:n] = a[:n]
-            out[n:] = a[0]
-            return out
-
-        span = tracer.span("verdict-service.classify",
-                           attrs={"records": n, "rows": rows}) \
-            if telem else None
-        t0 = time.perf_counter()
-        batch = make_full_batch(
-            endpoint=pad(soa["endpoint"]), saddr=pad(soa["saddr"]),
-            daddr=pad(soa["daddr"]), sport=pad(soa["sport"]),
-            dport=pad(soa["dport"]), proto=pad(soa["proto"]),
-            direction=pad(soa["direction"]),
-            tcp_flags=pad(soa["tcp_flags"]),
-            is_fragment=pad(soa["is_fragment"]),
-            length=pad(soa["length"]))
-        t_pack = time.perf_counter()
-        verdict, _event, identity, _nat = self.datapath.process(batch)
-        t_dispatch = time.perf_counter()
-        with self._stats_lock:
-            self.batches_dispatched += 1
-        out = (np.asarray(verdict)[:n].astype(np.int32),
-               np.asarray(identity)[:n].astype(np.int32))
-        if telem:
-            t_sync = time.perf_counter()
-            record_stage("verdict-service", "pack", t_pack - t0)
-            record_stage("verdict-service", "dispatch",
-                         t_dispatch - t_pack)
-            # the blocking boundary: host waits out device compute
-            record_stage("verdict-service", "sync",
-                         t_sync - t_dispatch)
-            span.finish()
-        return out
-
     # --------------------------------------------------------- lifecycle
+
+    @property
+    def batches_dispatched(self) -> int:
+        """Device launches on this service's serving lane since the
+        service was constructed (the shared lane also counts other
+        callers' launches — batching health, not an exact ledger)."""
+        return self._dispatcher.batches - self._batches_base
+
+    def serving_stats(self) -> dict:
+        """The serving dispatcher's coalescing/error counters."""
+        return self._dispatcher.stats()
 
     @property
     def port(self) -> int:
@@ -342,6 +354,11 @@ class VerdictService:
     def shutdown(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        # a private lane dies with the service; the engine's shared
+        # lane keeps serving other callers
+        if self._dispatcher is not getattr(self.datapath, "_serving",
+                                           None):
+            self._dispatcher.close()
 
 
 class VerdictClient:

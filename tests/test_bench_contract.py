@@ -56,7 +56,7 @@ def test_smoke_final_line_parses_and_fits(tmp_path):
     # per-config {value, vs_baseline} pairs
     suite = extra["suite"]
     for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
-                 "capacity", "incremental"):
+                 "capacity", "incremental", "latency-tier"):
         assert name in suite, f"{name} missing from compact suite"
         assert "value" in suite[name]
         assert "vs_baseline" in suite[name]
@@ -74,6 +74,20 @@ def test_smoke_writes_full_result_file(tmp_path):
     # the FULL suite detail survives in the file (dropped from the line)
     http = res["extra"]["suite_configs"]["http-regex"]
     assert http["extra"]["engine_selection"]
+    # the latency-tier schema is pinned: per-batch-size sync vs
+    # serving p50/p99 (b256 is the acceptance row) + coalescing block
+    lat = res["extra"]["suite_configs"]["latency-tier"]
+    assert lat["unit"] == "x"
+    b256 = lat["extra"]["per_batch_us"]["256"]
+    for key in ("sync_p50_us", "sync_p99_us", "serving_p50_us",
+                "serving_p99_us", "serving_interval_us",
+                "p99_speedup"):
+        assert key in b256, key
+    assert "under_100us_b256" in lat["extra"]
+    co = lat["extra"]["coalesce"]
+    for key in ("frame_p99_us", "mean_records_per_launch",
+                "sync_b1_p99_us"):
+        assert key in co, key
     # and the committed on-accel artifact is embedded here, not inline
     assert "last_on_accel" in res["extra"]
     assert res["extra"]["last_on_accel"]["result"]["value"]

@@ -22,6 +22,7 @@ import re
 import cilium_tpu.utils.metrics as metrics_mod
 import cilium_tpu.utils.resilience  # noqa: F401
 import cilium_tpu.observability  # noqa: F401
+import cilium_tpu.datapath.serving  # noqa: F401
 
 README = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "README.md")

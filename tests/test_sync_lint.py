@@ -1,0 +1,114 @@
+"""Hot-dispatch sync-point lint: the async serving path can't silently
+regress.
+
+The latency-tier PR's whole win is that the steady-state dispatch loop
+never blocks on device compute — the one permitted synchronization is
+the ticket-completion transfer in the serving dispatcher's "complete"
+stage (a flagged blocking boundary, always one batch behind the launch
+front).  This lint holds that line structurally: any device-sync
+construct (``block_until_ready``, ``np.asarray`` on an in-flight
+array, ``jax.device_get``) inside the hot dispatch modules — or inside
+the engine's hot functions — must carry an explicit
+``# sync-ok: <reason>`` marker naming why that boundary is allowed.
+Adding an unmarked sync is a test failure, not a review nit.
+"""
+
+import ast
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# whole modules on the steady-state dispatch path
+HOT_MODULES = (
+    "cilium_tpu/datapath/serving.py",
+    "cilium_tpu/verdict_service.py",
+    "cilium_tpu/l7/parser.py",
+)
+
+# the engine is hot only in its dispatch functions — table loading,
+# map dumps and replay are control-plane and sync freely
+ENGINE_MODULE = "cilium_tpu/datapath/engine.py"
+ENGINE_HOT_FUNCS = {"process", "process6", "process_packed",
+                    "_flow_step_variant", "_timestamp",
+                    "_account_dispatch", "_flush_verdict_counts",
+                    "serving"}
+
+# device-sync constructs; (?<!j) keeps jnp.asarray (an async H2D used
+# by the pack stage) out of the np.asarray net
+SYNC_RE = re.compile(
+    r"block_until_ready|(?<!j)np\.asarray\(|jax\.device_get"
+    r"|\.addressable_data\(|device_put_sharded")
+
+MARKER_RE = re.compile(r"#\s*sync-ok:\s*\S")
+
+
+def _module_lines(relpath):
+    with open(os.path.join(REPO, relpath)) as f:
+        return f.read().splitlines()
+
+
+def _engine_hot_lines():
+    """(lineno, text) for every line inside the engine's hot
+    functions, located via the AST so refactors can't silently move a
+    function out of lint coverage."""
+    lines = _module_lines(ENGINE_MODULE)
+    tree = ast.parse("\n".join(lines))
+    found = set()
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in ENGINE_HOT_FUNCS:
+            found.add(node.name)
+            for ln in range(node.lineno, node.end_lineno + 1):
+                out.append((ln, lines[ln - 1]))
+    missing = ENGINE_HOT_FUNCS - found
+    assert not missing, \
+        f"engine hot functions renamed/removed — update lint: {missing}"
+    return out
+
+
+def _all_hot_lines():
+    for rel in HOT_MODULES:
+        for i, line in enumerate(_module_lines(rel), start=1):
+            yield rel, i, line
+    for ln, line in _engine_hot_lines():
+        yield ENGINE_MODULE, ln, line
+
+
+def test_no_unflagged_sync_in_hot_dispatch_modules():
+    violations = [
+        f"{rel}:{ln}: {line.strip()}"
+        for rel, ln, line in _all_hot_lines()
+        if SYNC_RE.search(line) and "sync-ok" not in line]
+    assert not violations, (
+        "device synchronization inside the hot dispatch path without "
+        "an explicit '# sync-ok: <reason>' marker:\n"
+        + "\n".join(violations))
+
+
+def test_sync_ok_markers_carry_reasons():
+    bare = [
+        f"{rel}:{ln}: {line.strip()}"
+        for rel, ln, line in _all_hot_lines()
+        if "sync-ok" in line and not MARKER_RE.search(line)]
+    assert not bare, (
+        "'sync-ok' markers must name their reason "
+        "('# sync-ok: <why this boundary is allowed>'):\n"
+        + "\n".join(bare))
+
+
+def test_whitelisted_boundaries_stay_bounded():
+    """The whitelist itself is pinned: the serving path keeps exactly
+    its known sync boundaries (the ticket-completion transfer pair in
+    serving.py, the is_ready-gated verdict-count drain in the engine).
+    Growing this list is a deliberate, reviewed act."""
+    marked = [(rel, ln) for rel, ln, line in _all_hot_lines()
+              if "sync-ok" in line and SYNC_RE.search(line)]
+    by_module = {}
+    for rel, _ln in marked:
+        by_module[rel] = by_module.get(rel, 0) + 1
+    assert by_module == {
+        "cilium_tpu/datapath/serving.py": 2,
+        ENGINE_MODULE: 1,
+    }, by_module
