@@ -105,6 +105,31 @@ def _smoke_result():
                                   "strategy": "stride", "k": 3,
                                   "dtype": "int32", "classes": 29,
                                   "states": 96}}}
+    # the overload config's pinned output schema: per-multiplier legs
+    # with accepted-latency percentiles + shed accounting, admission
+    # control vs the unbounded pre-change queue
+    leg = lambda p99, shed, q: {  # noqa: E731 — schema fixture
+        "offered_frames": 1000, "offered_records_per_sec": 700000,
+        "accepted": 900, "shed": 100, "shed_rate": shed,
+        "shed_reasons": {"overflow": 90, "deadline": 10},
+        "accepted_p50_ms": p99 / 2, "accepted_p99_ms": p99,
+        "max_queue_records": q}
+    suite["overload"] = {
+        "metric": "overload_p99_containment_2x", "value": 7,
+        "unit": "x", "vs_baseline": 7.0,
+        "extra": {"smoke": True,
+                  "capacity_records_per_sec": 360_000,
+                  "frame_records": 256, "horizon_s": 1.0,
+                  "deadline_s": 0.08, "max_pending_records": 16384,
+                  "legs": {
+                      "admission": {"1x": leg(33.0, 0.01, 16384),
+                                    "2x": leg(47.0, 0.12, 16384),
+                                    "4x": leg(112.0, 0.63, 16384)},
+                      "unbounded": {"1x": leg(24.0, 0.0, 4352),
+                                    "2x": leg(334.0, 0.0, 188928),
+                                    "4x": leg(1004.0, 0.0, 664832)}},
+                  "admission_bounds_queue": True,
+                  "admission_p99_bounded_2x": True}}
     # the latency-tier config's pinned output schema: per-batch-size
     # sync vs serving p50/p99 plus the coalescing block
     suite["latency-tier"] = {
@@ -367,11 +392,12 @@ def run_bench():
     try:
         import bench_suite
         # latency-tier leads: the serving-path latency claim must
-        # never be the config the time budget drops
-        for name in ("latency-tier", "identity-l4", "http-regex",
-                     "kafka-acl", "fqdn", "capacity", "incremental",
-                     "flows-overhead", "tracing-overhead",
-                     "provenance-overhead"):
+        # never be the config the time budget drops; overload rides
+        # right behind it (the survivable-serving admission claim)
+        for name in ("latency-tier", "overload", "identity-l4",
+                     "http-regex", "kafka-acl", "fqdn", "capacity",
+                     "incremental", "flows-overhead",
+                     "tracing-overhead", "provenance-overhead"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"
                 continue

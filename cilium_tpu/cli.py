@@ -126,6 +126,11 @@ def cmd_status(c: Client, args) -> int:
               f"{tr['verify-on-retry']} verified, "
               f"{tr['watch-relists']} relists, "
               f"{len(open_breakers)} breakers open")
+    dp_state = st.get("dataplane") or {}
+    if dp_state.get("mode", "ok") != "ok":
+        # the loudest line status can carry: the device lane is down
+        # and traffic is being served fail-static from the host oracle
+        print(f"Dataplane:     {dp_state.get('status')}")
     mp = st.get("map-pressure") or {}
     for warning in mp.get("warnings", []):
         print(f"MapPressure:   WARNING {warning}")

@@ -90,6 +90,21 @@ class Daemon:
         self.datapath.telemetry_enabled = self.config.enable_tracing
         self.datapath.on_revision_served = \
             self.propagation.revision_served
+        # dataplane supervision (datapath/supervisor.py): overload
+        # admission control + device-fault circuit breaking with
+        # fail-static host fallback on the serving lane; the recovery
+        # gate is the FULL drift audit (PR 6) — a rebuilt device table
+        # only resumes serving after replaying clean against the host
+        # policy oracles
+        self.datapath.configure_supervision(
+            enabled=self.config.enable_supervision,
+            watchdog_s=self.config.supervisor_watchdog_s,
+            failure_threshold=self.config.supervisor_failure_threshold,
+            reset_s=self.config.supervisor_reset_s,
+            new_flow_policy=self.config.degraded_new_flow_policy,
+            recovery_gate=self._dataplane_recovery_gate,
+            max_pending=self.config.serving_max_pending,
+            default_deadline=self.config.serving_deadline_s or None)
         # incremental policy realization: one endpoint's regeneration
         # writes one device-table row (syncPolicyMap analog); the
         # engine re-jits only when the stack's geometry grows
@@ -730,6 +745,16 @@ class Daemon:
             self._drift_report = report
         return report
 
+    def _dataplane_recovery_gate(self) -> bool:
+        """The device lane's resumption gate: after the supervisor
+        rebuilds the tables from the host-of-record, a drift-audit
+        replay must come back clean before the half-open probe may
+        dispatch — a corrupted rebuild re-opens the breaker instead of
+        serving wrong verdicts."""
+        report = self.run_drift_audit(
+            samples=min(32, self.config.drift_audit_samples))
+        return report.get("status") in ("ok", "idle")
+
     def drift_report(self) -> Optional[Dict]:
         with self._lock:
             return self._drift_report
@@ -1196,6 +1221,11 @@ class Daemon:
             "transports": transport_resilience.status_summary(),
             "datapath": {"revision": self.datapath.revision,
                          "conntrack-slots": self.datapath.ct.slots},
+            # dataplane serving mode (datapath/supervisor.py): fails
+            # LOUDLY while the device lane is degraded — traffic is
+            # being served fail-static from the host oracle, which is
+            # correct-but-slow; an operator must see it immediately
+            "dataplane": self._dataplane_status(),
             # device-table fill fractions + threshold warnings
             # (cilium_bpf_map_pressure analog); `cilium-tpu status
             # --verbose` renders the same report
@@ -1218,6 +1248,19 @@ class Daemon:
             # runtime capability probes (bpf/run_probes.sh analog)
             "features": self._features(),
         }
+
+    def _dataplane_status(self) -> Dict:
+        out = self.datapath.supervision_status()
+        mode = out.get("mode", "ok")
+        if mode == "ok":
+            out["status"] = "ok"
+        else:
+            sup = (out.get("serving") or {}).get("supervisor") or {}
+            out["status"] = (
+                f"{mode.upper()}: device lane faulted "
+                f"({sup.get('last-fault')}); serving fail-static "
+                f"from the host oracle")
+        return out
 
     def _provenance_status(self) -> Dict:
         report = self.drift_report()

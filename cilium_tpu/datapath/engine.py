@@ -117,6 +117,16 @@ class Datapath:
         # the shared continuous micro-batching dispatcher
         # (datapath/serving.py), created on first use
         self._serving = None
+        # host-of-record policy states (load_policy mode) — what the
+        # fail-static oracle and the recovery gate answer from when no
+        # DeviceTableManager owns the tensors
+        self._host_states: Optional[Sequence[PolicyMapState]] = None
+        # dataplane supervision knobs (datapath/supervisor.py): the
+        # serving lane wraps launches in a DeviceSupervisor unless
+        # disabled; enable_supervision=False gives the exact
+        # pre-supervision dispatch path (and the compiled program is
+        # byte-identical either way — supervision is host-side only)
+        self._supervision_cfg: Dict = {"enabled": True}
         # verdict provenance (datapath/verdict.py Provenance): when
         # enabled, both family steps additionally emit the matched
         # policymap slot + decision tier per packet; the last batch's
@@ -236,10 +246,16 @@ class Datapath:
                     ) -> None:
         with self._lock:
             self._table_mgr = None
+            # host-of-record for the fail-static oracle: slot i serves
+            # map_states[i] (the exact states the tables compile from)
+            self._host_states = list(map_states)
             self.compiled_policy = compile_endpoints(map_states,
                                                      revision=revision)
             if ipcache_prefixes is not None or \
                     self.compiled_ipcache is None:
+                # keep the host mirror in lockstep with the compiled
+                # LPM (map_dump + the fail-static oracle read it)
+                self.ipcache_prefixes = dict(ipcache_prefixes or {})
                 self.compiled_ipcache = compile_lpm(ipcache_prefixes or {})
             self.revision = revision
             self._rebuild()
@@ -255,6 +271,7 @@ class Datapath:
             self._table_mgr = mgr
             if ipcache_prefixes is not None or \
                     self.compiled_ipcache is None:
+                self.ipcache_prefixes = dict(ipcache_prefixes or {})
                 self.compiled_ipcache = compile_lpm(ipcache_prefixes or {})
             self._rebuild()
 
@@ -659,17 +676,77 @@ class Datapath:
 
     # -- the latency-tier serving path (datapath/serving.py) -----------------
 
+    def configure_supervision(self, enabled: bool = True,
+                              **knobs) -> None:
+        """Set the serving lane's supervision config BEFORE first use
+        of serving().  Knobs: watchdog_s, failure_threshold, reset_s,
+        max_reset_s, new_flow_policy, recovery_gate, oracle_refresh_s
+        (DeviceSupervisor kwargs) plus max_pending/default_deadline
+        (admission control).  ``enabled=False`` restores the exact
+        pre-supervision dispatch path."""
+        with self._lock:
+            if self._serving is not None:
+                raise RuntimeError(
+                    "serving lane already created; configure "
+                    "supervision before first serving() use")
+            self._supervision_cfg = {"enabled": enabled, **knobs}
+
     def serving(self):
         """THE shared continuous micro-batching dispatcher for this
         engine (created on first use): the verdict service, L7 plane
         and direct callers submit record chunks here so concurrent
         endpoints coalesce into one device launch instead of
-        serializing pack+dispatch+sync on the engine lock."""
+        serializing pack+dispatch+sync on the engine lock.  Unless
+        supervision is disabled, launches run under a DeviceSupervisor
+        (datapath/supervisor.py): overload admission control, device-
+        fault circuit breaking with fail-static host fallback, and
+        breaker-gated recovery."""
         with self._lock:
             if self._serving is None:
                 from .serving import VerdictDispatcher
-                self._serving = VerdictDispatcher(self)
+                cfg = dict(self._supervision_cfg)
+                supervisor = None
+                admission = {
+                    "max_pending": cfg.pop("max_pending", None),
+                    "default_deadline": cfg.pop("default_deadline",
+                                                None)}
+                if cfg.pop("enabled", True):
+                    from .supervisor import DeviceSupervisor
+                    supervisor = DeviceSupervisor(self, **cfg)
+                self._serving = VerdictDispatcher(
+                    self, supervisor=supervisor, **admission)
             return self._serving
+
+    def supervision_status(self) -> Dict:
+        """The dataplane block of the agent status path: serving mode
+        (ok/degraded/recovering), breaker state, shed/fail-static
+        accounting.  Never CREATES the serving lane — a status probe
+        must not spin up dispatcher threads."""
+        with self._lock:
+            serving = self._serving
+        if serving is None:
+            return {"mode": "ok", "serving": None,
+                    "supervised": self._supervision_cfg.get(
+                        "enabled", True)}
+        sup = serving.supervisor
+        out = {"mode": sup.mode if sup is not None else "ok",
+               "supervised": sup is not None,
+               "serving": serving.stats()}
+        return out
+
+    def host_policy_states(self) -> Dict[int, PolicyMapState]:
+        """{table slot: host-of-record PolicyMapState} — what the
+        fail-static oracle enforces and the recovery gate replays
+        against.  Sourced from the DeviceTableManager in incremental
+        mode, from the states load_policy compiled otherwise."""
+        with self._lock:
+            mgr = self._table_mgr
+            states = self._host_states
+        if mgr is not None:
+            return mgr.states_by_slot()
+        if states is None:
+            return {}
+        return {slot: st for slot, st in enumerate(states)}
 
     # -- self-telemetry (observability/) -------------------------------------
 

@@ -36,7 +36,20 @@ Three mechanisms, one dispatcher thread:
 Failure semantics extend ``l7/parser.VerdictBatcher``'s guarantee to
 the shared tier: a dispatch (or completion) that raises fails closed —
 every frame in exactly that batch resolves to a deny verdict with the
-error attached to its ticket; other batches are untouched.
+error attached to its ticket; other batches are untouched.  With a
+``DeviceSupervisor`` attached (datapath/supervisor.py), device faults
+degrade further instead: the batch is served **fail-static from the
+host oracle** (established flows keep their verdicts, new flows get
+the configured degraded-mode policy) and the breaker-gated recovery
+path brings the device lane back — the survivable-serving tier.
+
+Overload protection (admission control): the pending queue is
+weight-bounded (``max_pending``); work that would overflow it is shed
+fail-closed at submit time, tickets may carry a deadline and expire
+unserved work is shed at drain time — both with distinct
+``serving_shed_total{reason}`` accounting — and a hysteresis watermark
+pair flips the ``dataplane_overloaded`` gauge so callers
+(verdict_service, VerdictBatcher) push back instead of queuing.
 
 Sync-point discipline: the ONLY device synchronization on this path is
 the ticket-completion transfer in ``_finalize`` (flagged as a blocking
@@ -55,7 +68,7 @@ import numpy as np
 
 from ..observability.stages import record_stage
 from ..utils.bucketing import bucket_size
-from ..utils.metrics import registry
+from ..utils.metrics import DATAPLANE_OVERLOADED, registry
 from .events import DROP_POLICY
 # the packed staging row order, unpacked by full_datapath_step_packed
 # inside the fused program; the names also match the
@@ -70,6 +83,19 @@ SERVING_FRAMES = registry.counter(
     "serving_frames_total",
     "Frames (submissions) coalesced through the serving dispatcher, "
     "by lane")
+SERVING_SHED = registry.counter(
+    "serving_shed_total",
+    "Frames shed fail-closed by serving admission control, by lane "
+    "and reason (overflow / deadline / closed)")
+
+
+class ShedError(RuntimeError):
+    """The frame was shed by admission control (queue overflow or an
+    expired ticket deadline) — fail-closed, never dispatched."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"shed by admission control: {reason}")
+        self.reason = reason
 
 
 class Ticket:
@@ -78,13 +104,17 @@ class Ticket:
     results plus the error that caused them)."""
 
     __slots__ = ("_event", "value", "error", "submitted_at",
-                 "_callbacks", "_cb_lock")
+                 "deadline", "_callbacks", "_cb_lock")
 
-    def __init__(self):
+    def __init__(self, deadline: Optional[float] = None):
         self._event = threading.Event()
         self.value = None
         self.error: Optional[BaseException] = None
         self.submitted_at = time.perf_counter()
+        # absolute monotonic deadline: unserved work older than this
+        # is shed at drain time (admission control), never dispatched
+        self.deadline = None if deadline is None else \
+            time.monotonic() + deadline
         self._callbacks: List[Callable] = []
         self._cb_lock = threading.Lock()
 
@@ -147,7 +177,12 @@ class ContinuousDispatcher:
                  depth: int = 2, window: float = 0.0,
                  weight: Callable = lambda item: 1,
                  lane: str = "serving",
-                 telemetry: Callable[[], bool] = lambda: True):
+                 telemetry: Callable[[], bool] = lambda: True,
+                 max_pending: Optional[int] = None,
+                 default_deadline: Optional[float] = None,
+                 overload_high: float = 0.75,
+                 overload_low: float = 0.25,
+                 supervisor=None):
         self._launch = launch
         self._finalize = finalize
         self._deny = deny
@@ -162,27 +197,74 @@ class ContinuousDispatcher:
         self._pending: "deque[Tuple[object, Ticket]]" = deque()
         self._inflight: "deque[Tuple[object, list, list]]" = deque()
         self._closed = False
+        # ---- admission control: weight-bounded pending queue with a
+        # hysteresis overload watermark pair (None = unbounded, the
+        # pre-supervision behavior)
+        self.max_pending = max_pending
+        self.default_deadline = default_deadline
+        self._pending_weight = 0
+        self._high_mark = None if max_pending is None else \
+            max(1, int(max_pending * overload_high))
+        self._low_mark = None if max_pending is None else \
+            max(0, int(max_pending * overload_low))
+        self.overloaded = False
+        # ---- device-fault supervision (datapath/supervisor.py):
+        # classify faults, fail static from the host oracle, recover
+        self.supervisor = supervisor
         # observability: how well the batching is working
         self.batches = 0
         self.frames = 0
         self.items_total = 0
         self.max_batch_seen = 0
         self.errors = 0
+        self.static_batches = 0
+        self.shed: Dict[str, int] = {}
+        self.max_pending_seen = 0
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"serving-{lane}")
         self._thread.start()
 
     # ------------------------------------------------------------ submit
 
-    def submit(self, item) -> Ticket:
-        """Queue one item from any thread; returns its Ticket."""
-        ticket = Ticket()
+    def _shed(self, item, ticket: Ticket, reason: str) -> Ticket:
+        """Fail the item closed at admission time."""
+        self.shed[reason] = self.shed.get(reason, 0) + 1
+        SERVING_SHED.inc(labels={"lane": self.lane, "reason": reason})
+        ticket.resolve(self._deny(item), ShedError(reason))
+        return ticket
+
+    def _set_overloaded_locked(self, value: bool) -> None:
+        if value != self.overloaded:
+            self.overloaded = value
+            DATAPLANE_OVERLOADED.set(1.0 if value else 0.0,
+                                     labels={"lane": self.lane})
+
+    def submit(self, item, deadline: Optional[float] = None) -> Ticket:
+        """Queue one item from any thread; returns its Ticket.
+
+        ``deadline`` (seconds from now; falls back to the lane's
+        ``default_deadline``) bounds how long the item may wait
+        unserved: expired work is shed fail-closed, never dispatched.
+        A full pending queue sheds immediately (reason "overflow")."""
+        if deadline is None:
+            deadline = self.default_deadline
+        ticket = Ticket(deadline=deadline)
+        w = self._weight(item)
         with self._cond:
             if self._closed:
                 ticket.resolve(self._deny(item),
                                RuntimeError("dispatcher closed"))
                 return ticket
+            if self.max_pending is not None and \
+                    self._pending_weight + w > self.max_pending:
+                return self._shed(item, ticket, "overflow")
             self._pending.append((item, ticket))
+            self._pending_weight += w
+            if self._pending_weight > self.max_pending_seen:
+                self.max_pending_seen = self._pending_weight
+            if self._high_mark is not None and \
+                    self._pending_weight >= self._high_mark:
+                self._set_overloaded_locked(True)
             self._cond.notify()
         return ticket
 
@@ -202,15 +284,31 @@ class ContinuousDispatcher:
         if wait and self.window > 0 and not self._closed:
             time.sleep(self.window)
         batch: List[Tuple[object, Ticket]] = []
+        expired: List[Tuple[object, Ticket]] = []
         total = 0
+        now = time.monotonic()
         with self._cond:
             while self._pending:
                 w = self._weight(self._pending[0][0])
+                head_deadline = self._pending[0][1].deadline
+                if head_deadline is not None and head_deadline <= now:
+                    # deadline-aware admission: expired work is shed
+                    # fail-closed, never dispatched — a stale verdict
+                    # answers nothing and only delays live traffic
+                    expired.append(self._pending.popleft())
+                    self._pending_weight -= w
+                    continue
                 if batch and total + w > self.max_batch:
                     break
                 item, ticket = self._pending.popleft()
+                self._pending_weight -= w
                 batch.append((item, ticket))
                 total += w
+            if self._low_mark is not None and self.overloaded and \
+                    self._pending_weight <= self._low_mark:
+                self._set_overloaded_locked(False)
+        for item, ticket in expired:
+            self._shed(item, ticket, "deadline")
         return batch, total
 
     def _run(self) -> None:
@@ -234,6 +332,9 @@ class ContinuousDispatcher:
         with self._cond:
             leftovers = list(self._pending)
             self._pending.clear()
+            self._pending_weight = 0
+            if self._low_mark is not None:
+                self._set_overloaded_locked(False)
         for item, ticket in leftovers:
             ticket.resolve(self._deny(item),
                            RuntimeError("dispatcher closed"))
@@ -242,11 +343,19 @@ class ContinuousDispatcher:
         telem = self._telemetry()
         t0 = time.perf_counter() if telem else 0.0
         items = [item for item, _t in batch]
-        try:
-            handle = self._launch(items, total)
-        except Exception as e:  # noqa: BLE001 — fail closed: deny
-            self._fail(batch, e)   # exactly this batch's frames
-            return
+        if self.supervisor is not None:
+            on_device, payload = self.supervisor.launch(
+                self._launch, items, total)
+            if not on_device:
+                self._resolve_static(batch, payload)
+                return
+            handle = payload
+        else:
+            try:
+                handle = self._launch(items, total)
+            except Exception as e:  # noqa: BLE001 — fail closed: deny
+                self._fail(batch, e)   # exactly this batch's frames
+                return
         if telem:
             record_stage(self.family, "queue-wait",
                          t0 - batch[0][1].submitted_at)
@@ -265,11 +374,20 @@ class ContinuousDispatcher:
         handle, batch, weights = self._inflight.popleft()
         telem = self._telemetry()
         t0 = time.perf_counter() if telem else 0.0
-        try:
-            results = self._finalize(handle, weights)
-        except Exception as e:  # noqa: BLE001 — fail closed: deny
-            self._fail(batch, e)   # exactly this batch's frames
-            return
+        if self.supervisor is not None:
+            ok, payload = self.supervisor.finalize(
+                self._finalize, handle, weights,
+                [item for item, _t in batch])
+            if not ok:
+                self._resolve_static(batch, payload)
+                return
+            results = payload
+        else:
+            try:
+                results = self._finalize(handle, weights)
+            except Exception as e:  # noqa: BLE001 — fail closed: deny
+                self._fail(batch, e)   # exactly this batch's frames
+                return
         if telem:
             # the one blocking boundary on this path: host waits out
             # device compute for the batch launched one step earlier
@@ -283,19 +401,44 @@ class ContinuousDispatcher:
         for item, ticket in batch:
             ticket.resolve(self._deny(item), error)
 
+    def _resolve_static(self, batch, payload) -> None:
+        """Resolve one batch with the supervisor's fail-static answer
+        (results carry NO error: they are real last-known-good
+        verdicts, not denials); an unusable oracle falls back to the
+        fail-closed deny contract."""
+        results, error = payload
+        if results is None:
+            self._fail(batch, error or
+                       RuntimeError("dataplane degraded"))
+            return
+        self.static_batches += 1
+        self.frames += len(batch)
+        for (item, ticket), res in zip(batch, results):
+            ticket.resolve(res)
+
     # ---------------------------------------------------------- lifecycle
 
     def stats(self) -> Dict:
         with self._cond:
             queued = len(self._pending)
-        return {"lane": self.lane, "batches": self.batches,
-                "frames": self.frames, "items": self.items_total,
-                "max_batch": self.max_batch_seen,
-                "errors": self.errors, "queued": queued,
-                "inflight": len(self._inflight),
-                "mean_batch": round(
-                    self.items_total / self.batches, 2)
-                if self.batches else 0.0}
+            pending_weight = self._pending_weight
+        out = {"lane": self.lane, "batches": self.batches,
+               "frames": self.frames, "items": self.items_total,
+               "max_batch": self.max_batch_seen,
+               "errors": self.errors, "queued": queued,
+               "inflight": len(self._inflight),
+               "mean_batch": round(
+                   self.items_total / self.batches, 2)
+               if self.batches else 0.0,
+               # admission control + supervision
+               "shed": dict(self.shed),
+               "overloaded": self.overloaded,
+               "pending-weight": pending_weight,
+               "max-pending-seen": self.max_pending_seen,
+               "static-batches": self.static_batches}
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.stats()
+        return out
 
     def close(self, timeout: float = 5.0) -> None:
         with self._cond:
@@ -317,7 +460,10 @@ class VerdictDispatcher(ContinuousDispatcher):
 
     def __init__(self, datapath, *, max_batch: int = 1 << 15,
                  min_rows: int = 16, depth: int = 2,
-                 window: float = 0.0, lane: str = "verdict"):
+                 window: float = 0.0, lane: str = "verdict",
+                 max_pending: Optional[int] = None,
+                 default_deadline: Optional[float] = None,
+                 supervisor=None):
         self._datapath = datapath
         self._min_rows = min_rows
         # staging rings: (bucket rows) -> list of depth+1 packed
@@ -332,15 +478,18 @@ class VerdictDispatcher(ContinuousDispatcher):
                          depth=depth, window=window,
                          weight=lambda chunk: chunk[1], lane=lane,
                          telemetry=lambda: getattr(
-                             datapath, "telemetry_enabled", False))
+                             datapath, "telemetry_enabled", False),
+                         max_pending=max_pending,
+                         default_deadline=default_deadline,
+                         supervisor=supervisor)
 
-    def submit_records(self, soa: Dict[str, np.ndarray], n: int
-                       ) -> Ticket:
+    def submit_records(self, soa: Dict[str, np.ndarray], n: int,
+                       deadline: Optional[float] = None) -> Ticket:
         """Queue ``n`` records given as the PacketRing SoA dict (int32
         arrays, caller-owned — they are read once at pack time on the
         dispatcher thread, so hand over fresh arrays, not ring-backed
         views)."""
-        return self.submit((soa, int(n)))
+        return self.submit((soa, int(n)), deadline=deadline)
 
     # ------------------------------------------------------------- pack
 

@@ -196,11 +196,18 @@ class VerdictBatcher:
     def __init__(self, check_batch: Callable[[Sequence], Sequence],
                  max_batch: int = 512, max_wait: float = 0.001,
                  dispatch_split: "Optional[Tuple[Callable, Callable]]"
-                 = None, name: str = "l7"):
+                 = None, name: str = "l7",
+                 max_pending: "Optional[int]" = None,
+                 deadline_s: "Optional[float]" = None):
         from ..datapath.serving import ContinuousDispatcher
         self.check_batch = check_batch
         self.max_batch = max_batch
         self.max_wait = max_wait
+        # admission control: frames queued past deadline_s are shed
+        # fail-closed by the core, and check() pushes back (immediate
+        # deny) while the lane is above its overload watermark instead
+        # of queuing yet more work behind a saturated device
+        self.deadline_s = deadline_s
         if dispatch_split is not None:
             dispatch_fn, finalize_fn = dispatch_split
 
@@ -219,11 +226,20 @@ class VerdictBatcher:
 
         self._core = ContinuousDispatcher(
             launch, finalize, deny=lambda item: False,
-            max_batch=max_batch, window=max_wait, lane=name)
+            max_batch=max_batch, window=max_wait, lane=name,
+            max_pending=max_pending, default_deadline=deadline_s)
+
+    @property
+    def overloaded(self) -> bool:
+        return self._core.overloaded
 
     async def check(self, item) -> bool:
         """Queue one frame; resolves with its verdict (False on a
-        failed batch — fail closed)."""
+        failed batch — fail closed).  While the lane is overloaded
+        (admission high-watermark), pushes back immediately with a
+        deny instead of queuing — the L7 proxy's slow-down signal."""
+        if self._core.overloaded:
+            return False
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         ticket = self._core.submit(item)

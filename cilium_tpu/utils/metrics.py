@@ -262,6 +262,29 @@ POLICY_DRIFT = registry.counter(
 POLICY_DRIFT_AUDIT_RUNS = registry.counter(
     "policy_drift_audit_runs_total",
     "Completed drift-audit sweeps by result")
+# Dataplane supervision series (datapath/supervisor.py): the serving
+# lane's overload / device-fault / fail-static / recovery accounting —
+# the survivable-serving analog of the reference's fail-static
+# dataplane (daemon/state.go restore path: the kernel keeps forwarding
+# on last-known-good state while the agent is degraded).
+DATAPLANE_OVERLOADED = registry.gauge(
+    "dataplane_overloaded",
+    "1 while a serving lane is above its admission high-watermark "
+    "(hysteresis: clears at the low-watermark)")
+DATAPLANE_MODE = registry.gauge(
+    "dataplane_mode",
+    "Dataplane serving mode (0 ok / 1 degraded / 2 recovering)")
+DATAPLANE_RECOVERIES = registry.counter(
+    "dataplane_recoveries_total",
+    "Device-lane recoveries: breaker closed after a half-open probe "
+    "passed the table rebuild + drift-audit gate")
+DATAPLANE_DEVICE_FAULTS = registry.counter(
+    "dataplane_device_faults_total",
+    "Device-lane faults absorbed by the supervisor, by stage and kind")
+DATAPLANE_FAIL_STATIC = registry.counter(
+    "dataplane_fail_static_verdicts_total",
+    "Verdicts served from the host fail-static oracle while the "
+    "device lane is degraded")
 PROXY_REDIRECTS = registry.gauge(
     "proxy_redirects", "Number of active proxy redirects")
 PROXY_UPSTREAM_TIME = registry.histogram(

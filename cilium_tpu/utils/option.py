@@ -250,6 +250,32 @@ class DaemonConfig:
     # callable on demand).
     drift_audit_interval_s: float = 30.0
     drift_audit_samples: int = 64
+    # dataplane supervision (datapath/supervisor.py): overload
+    # admission control + device-fault circuit breaking with
+    # fail-static host fallback on the serving lane.  Disabling
+    # restores the exact pre-supervision dispatch path (the compiled
+    # device program is byte-identical either way).
+    enable_supervision: bool = True
+    # weight bound on the serving lane's pending queue (records);
+    # overflow is shed fail-closed with serving_shed_total{reason}
+    serving_max_pending: int = 1 << 17
+    # optional default serving deadline (seconds; 0 = none): queued
+    # work older than this is shed instead of dispatched
+    serving_deadline_s: float = 0.0
+    # degraded-mode policy for NEW flows while serving fail-static
+    # from the host oracle (established flows always keep their
+    # verdicts): "oracle" = enforce last-known-good policy on host,
+    # "deny" = no new flows while degraded, "allow" = open
+    degraded_new_flow_policy: str = "oracle"
+    # a finalize (the one blocking device sync) outliving this
+    # deadline is a device fault — the hung-complete watchdog
+    supervisor_watchdog_s: float = 10.0
+    # consecutive transient faults before the breaker opens (fatal
+    # faults trip it immediately)
+    supervisor_failure_threshold: int = 3
+    # first half-open probe delay; doubles per failed probe up to
+    # the resilience layer's max_reset
+    supervisor_reset_s: float = 1.0
     kvstore: str = "memory"
     kvstore_opts: Dict[str, str] = field(default_factory=dict)
     # runtime-mutable option map shared by new endpoints

@@ -209,10 +209,22 @@ class CircuitBreaker:
                 self._state == STATE_CLOSED and
                 self._failures >= self.failure_threshold)
             if tripped:
-                self._probe_at = time.monotonic() + self._current_reset
-                self._current_reset = min(self._current_reset * 2,
-                                          self.max_reset)
-                self._transition(STATE_OPEN)
+                self._open_locked()
+
+    def trip(self) -> None:
+        """Force the breaker open NOW, bypassing the consecutive-
+        failure grace — for faults classified fatal (a lost device
+        path will not heal within the failure-counting window).  Keeps
+        the same doubling reset cadence as counted failures."""
+        with self._mu:
+            self._failures = max(self._failures, self.failure_threshold)
+            self._open_locked()
+
+    def _open_locked(self) -> None:
+        self._probe_at = time.monotonic() + self._current_reset
+        self._current_reset = min(self._current_reset * 2,
+                                  self.max_reset)
+        self._transition(STATE_OPEN)
 
     def _transition(self, to: str) -> None:
         # callers hold self._mu

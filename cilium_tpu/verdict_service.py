@@ -88,7 +88,8 @@ class VerdictService:
                  max_batch: int = 1 << 15,
                  secret: "bytes | None" = None,
                  handshake_timeout: float = 5.0,
-                 frame_timeout: float = 30.0):
+                 frame_timeout: float = 30.0,
+                 submit_deadline_s: "float | None" = None):
         from .native import load
         load()  # the ring is mandatory here; fail at construction
         # Peer authentication: the reference keeps equivalent surfaces
@@ -114,6 +115,10 @@ class VerdictService:
         # arrives, its payload must follow within frame_timeout
         self.handshake_timeout = handshake_timeout
         self.frame_timeout = frame_timeout
+        # optional per-submission serving deadline: expired work is
+        # shed fail-closed by the dispatcher's admission control (the
+        # resulting ticket error drops the connection — fail fast)
+        self.submit_deadline_s = submit_deadline_s
         self.frames_served = 0
         self._stats_lock = threading.Lock()  # one drain thread per conn
         # device work goes through the engine's SHARED serving
@@ -214,6 +219,19 @@ class VerdictService:
 
             try:
                 while True:
+                    if getattr(self._dispatcher, "overloaded", False):
+                        # admission push-back: stop draining while the
+                        # serving lane is above its high watermark —
+                        # records stay queued in the SPSC ring, the
+                        # reader stalls when it fills, and TCP
+                        # backpressures the client instead of the
+                        # dispatcher queuing (and shedding) our work
+                        if inflight:
+                            complete_one()
+                        else:
+                            wake.wait(0.01)
+                            wake.clear()
+                        continue
                     with frames_lock:
                         have = len(frames) > 0
                     if not have:
@@ -250,7 +268,8 @@ class VerdictService:
                     # pop_batch returned fresh arrays — safe to hand
                     # to the dispatcher thread without copying
                     inflight.append(
-                        (self._dispatcher.submit_records(soa, n),
+                        (self._dispatcher.submit_records(
+                            soa, n, deadline=self.submit_deadline_s),
                          covers))
                     while len(inflight) >= PIPELINE_DEPTH:
                         complete_one()

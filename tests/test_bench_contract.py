@@ -56,7 +56,8 @@ def test_smoke_final_line_parses_and_fits(tmp_path):
     # per-config {value, vs_baseline} pairs
     suite = extra["suite"]
     for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
-                 "capacity", "incremental", "latency-tier"):
+                 "capacity", "incremental", "latency-tier",
+                 "overload"):
         assert name in suite, f"{name} missing from compact suite"
         assert "value" in suite[name]
         assert "vs_baseline" in suite[name]
@@ -88,6 +89,20 @@ def test_smoke_writes_full_result_file(tmp_path):
     for key in ("frame_p99_us", "mean_records_per_launch",
                 "sync_b1_p99_us"):
         assert key in co, key
+    # the overload schema is pinned: per-multiplier legs with accepted
+    # percentiles + shed accounting, admission vs unbounded
+    ovl = res["extra"]["suite_configs"]["overload"]
+    assert ovl["unit"] == "x"
+    for leg_name in ("admission", "unbounded"):
+        for mult in ("1x", "2x", "4x"):
+            row = ovl["extra"]["legs"][leg_name][mult]
+            for key in ("offered_frames", "accepted", "shed",
+                        "shed_rate", "shed_reasons",
+                        "accepted_p50_ms", "accepted_p99_ms",
+                        "max_queue_records"):
+                assert key in row, (leg_name, mult, key)
+    assert "admission_bounds_queue" in ovl["extra"]
+    assert "admission_p99_bounded_2x" in ovl["extra"]
     # and the committed on-accel artifact is embedded here, not inline
     assert "last_on_accel" in res["extra"]
     assert res["extra"]["last_on_accel"]["result"]["value"]

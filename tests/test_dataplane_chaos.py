@@ -1,0 +1,473 @@
+"""Device-lane chaos: the survivable-serving tier
+(datapath/supervisor.py) under injected faults.
+
+The acceptance journey, end to end: injected device fault -> breaker
+opens -> established-CT flows still ALLOW via the host fail-static
+oracle (no blanket deny) -> injected heal -> table rebuild +
+drift-audit gate -> breaker closes, dataplane_recoveries_total
+increments, status() returns to ok.  Plus the watchdog (a hung
+``complete`` sync is a fault), fault classification (fatal trips the
+breaker immediately), oracle parity (fail-static answers bit-exact
+with what the device would decide for new flows), the configured
+degraded-mode policies, a failing recovery gate keeping the lane
+degraded, and the disabled-supervision path dispatching the
+byte-identical pre-change program.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from bench import build_config1
+from cilium_tpu.datapath.engine import Datapath, make_full_batch
+from cilium_tpu.datapath.serving import VerdictDispatcher
+from cilium_tpu.datapath.supervisor import (DeviceSupervisor,
+                                            classify_fault)
+from cilium_tpu.utils.faultinject import (DeviceFaultInjector,
+                                          DeviceLaneFault)
+from cilium_tpu.utils.metrics import (DATAPLANE_DEVICE_FAULTS,
+                                      DATAPLANE_FAIL_STATIC,
+                                      DATAPLANE_RECOVERIES)
+
+N_ENDPOINTS = 8
+
+
+def _load_dp(**kw):
+    states, prefixes = build_config1(n_rules=40,
+                                     n_endpoints=N_ENDPOINTS)
+    dp = Datapath(ct_slots=1 << 12)
+    dp.telemetry_enabled = False
+    if kw:
+        dp.configure_supervision(**kw)
+    dp.load_policy(states, revision=1, ipcache_prefixes=prefixes)
+    return dp, prefixes
+
+
+def _supervised(dp, **kw):
+    kw.setdefault("watchdog_s", 5.0)
+    kw.setdefault("failure_threshold", 2)
+    kw.setdefault("reset_s", 0.05)
+    sup = DeviceSupervisor(dp, **kw)
+    disp = VerdictDispatcher(dp, supervisor=sup,
+                             lane=f"chaos-{id(sup) & 0xFFFF:x}")
+    inj = DeviceFaultInjector()
+    sup.install_fault_hook(inj)
+    return disp, sup, inj
+
+
+_SPORT = [20000]
+
+
+def _chunk(rng, n, prefixes=None, hit_frac=0.5):
+    """SoA record chunk; with ``prefixes``, the first ``hit_frac`` of
+    daddrs land inside installed ipcache prefixes so a share of the
+    batch genuinely ALLOWs (and creates CT entries)."""
+    base = _SPORT[0]
+    _SPORT[0] += n
+    daddr = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    if prefixes:
+        cidrs = list(prefixes)
+        for j in range(int(n * hit_frac)):
+            a = cidrs[j % len(cidrs)].split("/")[0].split(".")
+            daddr[j] = (int(a[0]) << 24) | (int(a[1]) << 16) | \
+                (int(a[2]) << 8) | 7
+    return {
+        "endpoint": rng.integers(0, N_ENDPOINTS, n).astype(np.int32),
+        "saddr": rng.integers(0, 1 << 32, n,
+                              dtype=np.uint32).view(np.int32),
+        "daddr": daddr.view(np.int32),
+        "sport": ((base + np.arange(n)) % 64000 + 1024
+                  ).astype(np.int32),
+        "dport": rng.integers(1, 65536, n).astype(np.int32),
+        "proto": np.full(n, 6, np.int32),
+        "direction": np.ones(n, np.int32),
+        "tcp_flags": np.full(n, 0x02, np.int32),
+        "is_fragment": np.zeros(n, np.int32),
+        "length": np.full(n, 256, np.int32),
+    }
+
+
+def _cp(c):
+    return {k: v.copy() for k, v in c.items()}
+
+
+def _submit(disp, c, n=None):
+    n = n if n is not None else len(c["sport"])
+    t = disp.submit_records(_cp(c), n)
+    v, i = t.result(timeout=120)
+    return t, np.asarray(v), np.asarray(i)
+
+
+# ------------------------------------------------ fault classification
+
+def test_fault_classification():
+    assert classify_fault(DeviceLaneFault(fatal=True)) == "fatal"
+    assert classify_fault(DeviceLaneFault()) == "transient"
+    assert classify_fault(OSError("link down")) == "transient"
+    # engine preconditions are caller errors, never device faults
+    assert classify_fault(
+        RuntimeError("no policy loaded")) == "caller"
+
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    assert classify_fault(
+        XlaRuntimeError("INTERNAL: device halted")) == "fatal"
+    assert classify_fault(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: oom")) == "transient"
+
+
+# ------------------------------------------- fail-static established
+
+def test_transient_faults_open_breaker_and_established_flows_survive():
+    """The core fail-static property: after the breaker opens, flows
+    with live CT entries keep their verdicts — no blanket deny."""
+    dp, prefixes = _load_dp()
+    disp, sup, inj = _supervised(dp)
+    rng = np.random.default_rng(5)
+    try:
+        c1 = _chunk(rng, 64, prefixes)
+        t, v1, i1 = _submit(disp, c1)
+        assert t.error is None
+        allowed = v1 >= 0
+        assert allowed.any(), "config must allow a share of c1"
+        sup.oracle.refresh()
+        assert sup.oracle.stats()["ct-entries"] > 0
+
+        static_before = DATAPLANE_FAIL_STATIC.total()
+        faults_before = DATAPLANE_DEVICE_FAULTS.total()
+        inj.fail_launch(times=2)          # threshold is 2
+        for _ in range(2):
+            t, v, _i = _submit(disp, c1)
+            assert t.error is None        # served static, not denied
+        assert sup.mode == "degraded"
+        assert sup.breaker.state == "open"
+        assert DATAPLANE_DEVICE_FAULTS.total() == faults_before + 2
+
+        # established flows keep their verdicts while degraded
+        t, vs, _is = _submit(disp, c1)
+        assert t.error is None
+        np.testing.assert_array_equal(vs[allowed],
+                                      np.maximum(v1[allowed], 0))
+        assert DATAPLANE_FAIL_STATIC.total() > static_before
+        assert disp.stats()["static-batches"] >= 1
+    finally:
+        disp.close()
+
+
+def test_fatal_fault_trips_breaker_immediately():
+    dp, prefixes = _load_dp()
+    disp, sup, inj = _supervised(dp, failure_threshold=5)
+    rng = np.random.default_rng(7)
+    try:
+        _submit(disp, _chunk(rng, 32, prefixes))  # settle + compile
+        sup.oracle.refresh()
+        inj.fail_launch(times=1, fatal=True)
+        t, _v, _i = _submit(disp, _chunk(rng, 32, prefixes))
+        assert t.error is None
+        assert sup.mode == "degraded"     # one fatal fault sufficed
+        assert sup.faults.get("fatal") == 1
+    finally:
+        disp.close()
+
+
+# -------------------------------------------------- watchdog deadline
+
+def test_hung_finalize_is_a_fault_via_watchdog():
+    """A finalize that outlives the watchdog deadline — the hung
+    ``complete`` sync of a wedged device path — must resolve the batch
+    fail-static within ~the watchdog budget, not hang the lane."""
+    dp, prefixes = _load_dp()
+    disp, sup, inj = _supervised(dp, watchdog_s=0.2,
+                                 failure_threshold=3)
+    rng = np.random.default_rng(9)
+    try:
+        _submit(disp, _chunk(rng, 32, prefixes))
+        sup.oracle.refresh()
+        inj.hang_finalize(seconds=1.5)
+        t0 = time.perf_counter()
+        t, _v, _i = _submit(disp, _chunk(rng, 32, prefixes))
+        took = time.perf_counter() - t0
+        assert t.error is None
+        assert took < 1.2, f"watchdog did not fire ({took:.2f}s)"
+        assert sup.faults.get("hung") == 1
+        assert sup.mode == "degraded"     # hung = trip immediately
+        # the abandoned worker eventually finishes; the lane recovers
+        time.sleep(1.6)
+        t, _v, _i = _submit(disp, _chunk(rng, 32, prefixes))
+        assert sup.mode == "ok" and sup.recoveries == 1
+    finally:
+        disp.close()
+
+
+# -------------------------------------------- oracle verdict parity
+
+@pytest.mark.parametrize("seed", [11, 13])
+def test_fail_static_new_flow_parity_with_device(seed):
+    """Degraded-mode 'oracle' answers for NEW flows must be bit-exact
+    with what the device path would decide (verdict AND identity) —
+    fail-static enforces last-known-good policy, it does not invent a
+    different one."""
+    dp, prefixes = _load_dp()
+    disp, sup, inj = _supervised(dp)
+    oracle_dp, _ = _load_dp()
+    rng = np.random.default_rng(seed)
+    try:
+        _submit(disp, _chunk(rng, 32, prefixes))
+        sup.oracle.refresh()
+        fresh = _chunk(rng, 200, prefixes)   # never seen by either dp
+        pkt = make_full_batch(**fresh)
+        dv, _e, di, _n = oracle_dp.process(pkt)
+        dv, di = np.asarray(dv), np.asarray(di)
+
+        inj.fail_launch(times=2)
+        for _ in range(2):
+            _submit(disp, _chunk(rng, 16, prefixes))
+        assert sup.mode == "degraded"
+        t, sv, si = _submit(disp, fresh)
+        assert t.error is None
+        np.testing.assert_array_equal(sv, dv)
+        np.testing.assert_array_equal(si, di)
+    finally:
+        disp.close()
+
+
+@pytest.mark.parametrize("policy,expect", [("deny", -1), ("allow", 0)])
+def test_degraded_new_flow_policy_knob(policy, expect):
+    dp, prefixes = _load_dp()
+    disp, sup, inj = _supervised(dp, new_flow_policy=policy)
+    rng = np.random.default_rng(17)
+    try:
+        _submit(disp, _chunk(rng, 16, prefixes))
+        sup.oracle.refresh()
+        inj.fail_launch(times=2)
+        for _ in range(2):
+            _submit(disp, _chunk(rng, 16, prefixes))
+        assert sup.mode == "degraded"
+        t, v, _i = _submit(disp, _chunk(rng, 32, prefixes))
+        assert t.error is None
+        assert (v == expect).all(), v
+    finally:
+        disp.close()
+
+
+# ------------------------------------------------------- recovery
+
+def test_recovery_gate_failure_keeps_lane_degraded():
+    """A half-open probe may NOT resume on a failing drift gate: the
+    breaker re-opens (doubling cadence) until the gate passes."""
+    gate_results = [False, False, True]
+    gate_calls = []
+
+    def gate():
+        gate_calls.append(time.monotonic())
+        return gate_results[min(len(gate_calls) - 1,
+                                len(gate_results) - 1)]
+
+    dp, prefixes = _load_dp()
+    disp, sup, inj = _supervised(dp, recovery_gate=gate,
+                                 reset_s=0.05)
+    rng = np.random.default_rng(19)
+    try:
+        _submit(disp, _chunk(rng, 16, prefixes))
+        sup.oracle.refresh()
+        inj.fail_launch(times=2)
+        for _ in range(2):
+            _submit(disp, _chunk(rng, 16, prefixes))
+        assert sup.mode == "degraded"
+        deadline = time.monotonic() + 20.0
+        while sup.mode != "ok" and time.monotonic() < deadline:
+            time.sleep(0.05)
+            _submit(disp, _chunk(rng, 8, prefixes))
+        assert sup.mode == "ok"
+        assert len(gate_calls) == 3      # two failed probes first
+        assert sup.recoveries == 1
+    finally:
+        disp.close()
+
+
+def test_transient_then_heal_script_recovers_with_probe_cadence():
+    """The scripted transient-then-heal choreography: every launch
+    faults for a while, the breaker holds the lane static between
+    probes, and the first healthy probe (gated) closes it."""
+    dp, prefixes = _load_dp()
+    disp, sup, inj = _supervised(dp, reset_s=0.05)
+    rng = np.random.default_rng(23)
+    try:
+        _submit(disp, _chunk(rng, 16, prefixes))
+        sup.oracle.refresh()
+        rec_before = DATAPLANE_RECOVERIES.total()
+        inj.script([("launch", "raise", False)] * 4)
+        deadline = time.monotonic() + 20.0
+        while (sup.mode != "ok" or inj.armed) and \
+                time.monotonic() < deadline:
+            t, _v, _i = _submit(disp, _chunk(rng, 8, prefixes))
+            assert t.error is None       # never fail-closed mid-chaos
+            time.sleep(0.02)
+        assert sup.mode == "ok"
+        assert DATAPLANE_RECOVERIES.total() > rec_before
+        assert inj.injected == 4
+    finally:
+        disp.close()
+
+
+def test_recovery_rebuilds_device_tables_from_host_of_record():
+    """While degraded, scribble over the LIVE device policy tensors
+    (what a real device loss looks like); recovery must rebuild from
+    the host-of-record, pass the drift gate, and serve correct
+    verdicts again."""
+    dp, prefixes = _load_dp()
+    disp, sup, inj = _supervised(dp)
+    rng = np.random.default_rng(29)
+    try:
+        c = _chunk(rng, 64, prefixes)
+        t, v1, _i = _submit(disp, c)
+        sup.oracle.refresh()
+        inj.fail_launch(times=2)
+        for _ in range(2):
+            _submit(disp, _chunk(rng, 8, prefixes))
+        assert sup.mode == "degraded"
+        # corrupt the device-resident policy stack (host-of-record,
+        # i.e. the compiled artifacts, stays intact)
+        import jax.numpy as jnp
+        bad = dp._tables.datapath._replace(
+            key_meta=jnp.zeros_like(dp._tables.datapath.key_meta))
+        dp._tables = dp._tables._replace(datapath=bad)
+        time.sleep(0.1)
+        fresh = _chunk(rng, 64, prefixes)
+        t, v2, _i = _submit(disp, fresh)
+        assert sup.mode == "ok" and sup.recoveries == 1
+        # the rebuilt tables answer like a pristine engine
+        oracle_dp, _ = _load_dp()
+        pkt = make_full_batch(**fresh)
+        dv = np.asarray(oracle_dp.process(pkt)[0])
+        np.testing.assert_array_equal(v2, dv)
+    finally:
+        disp.close()
+
+
+# ------------------------------------- disabled supervision contract
+
+def test_supervision_disabled_is_the_pre_change_path():
+    """enable_supervision=off: no supervisor on the lane, launch
+    failures keep the PR 7 fail-closed deny contract, and the
+    compiled device program is byte-identical to the supervised
+    engine's (supervision is host-side only)."""
+    import jax.numpy as jnp
+    dp_off, prefixes = _load_dp(enabled=False)
+    dp_on, _ = _load_dp()
+    disp_off = dp_off.serving()
+    disp_on = dp_on.serving()
+    try:
+        assert disp_off.supervisor is None
+        assert disp_on.supervisor is not None
+        packed = jnp.zeros((10, 16), jnp.int32)
+        lowered = [dp._step_packed.lower(
+            dp._tables, dp.ct.state, dp.counters, packed,
+            jnp.int32(1)).as_text() for dp in (dp_off, dp_on)]
+        assert lowered[0] == lowered[1]
+        # same records, same verdicts through both lanes
+        rng = np.random.default_rng(31)
+        c = _chunk(rng, 48, prefixes)
+        t_off, v_off, i_off = _submit(disp_off, c)
+        t_on, v_on, i_on = _submit(disp_on, c)
+        assert t_off.error is None and t_on.error is None
+        np.testing.assert_array_equal(v_off, v_on)
+        np.testing.assert_array_equal(i_off, i_on)
+    finally:
+        disp_off.close()
+        disp_on.close()
+
+
+# --------------------------------------------- daemon-level journey
+
+def test_daemon_journey_fault_failstatic_recovery_status():
+    """The acceptance journey on a LIVE daemon: device fault ->
+    breaker opens -> established flows still ALLOW fail-static ->
+    status() fails loudly -> heal -> rebuild + drift-audit gate ->
+    recovery counted, status back to ok."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.policy.jsonio import rules_from_json
+    from cilium_tpu.utils.option import DaemonConfig
+
+    cfg = DaemonConfig(state_dir="", drift_audit_interval_s=0,
+                       ct_checkpoint_interval_s=0,
+                       supervisor_reset_s=0.05,
+                       supervisor_watchdog_s=5.0,
+                       supervisor_failure_threshold=2)
+    d = Daemon(config=cfg)
+    try:
+        d.endpoint_create(1, ipv4="10.200.0.10", labels=["k8s:id=web"])
+        d.endpoint_create(2, ipv4="10.200.0.11", labels=["k8s:id=db"])
+        rules = rules_from_json(json.dumps([{
+            "endpointSelector": {"matchLabels": {"id": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"id": "web"}}],
+                "toPorts": [{"ports": [{"port": "5432",
+                                        "protocol": "TCP"}]}]}],
+            "labels": ["k8s:policy=t"]}]))
+        rev = d.policy_add(rules)
+        assert d.wait_for_policy_revision(rev, timeout=60)
+        assert d.status()["dataplane"]["status"] == "ok"
+
+        disp = d.datapath.serving()
+        sup = disp.supervisor
+        slot = d.endpoints.lookup(2).table_slot
+        web_ip = (10 << 24) | (200 << 16) | 10
+        db_ip = (10 << 24) | (200 << 16) | 11
+
+        def records(n, dport, sport0):
+            return {
+                "endpoint": np.full(n, slot, np.int32),
+                "saddr": np.full(n, web_ip, np.uint32).view(np.int32),
+                "daddr": np.full(n, db_ip, np.uint32).view(np.int32),
+                "sport": (sport0 + np.arange(n)).astype(np.int32),
+                "dport": np.full(n, dport, np.int32),
+                "proto": np.full(n, 6, np.int32),
+                "direction": np.zeros(n, np.int32),   # ingress to db
+                "tcp_flags": np.full(n, 0x02, np.int32),
+                "is_fragment": np.zeros(n, np.int32),
+                "length": np.full(n, 256, np.int32)}
+
+        allowed = records(8, 5432, 40000)
+        t, v, i = _submit(disp, allowed)
+        assert t.error is None and (v == 0).all()   # flows establish
+        sup.oracle.refresh()
+        assert sup.oracle.stats()["ct-entries"] >= 8
+
+        rec_before = DATAPLANE_RECOVERIES.total()
+        inj = DeviceFaultInjector()
+        sup.install_fault_hook(inj)
+        inj.fail_launch(times=2)
+        for _ in range(2):
+            _submit(disp, records(8, 5432, 40000))
+        # breaker open: status fails loudly
+        st = d.status()["dataplane"]
+        assert st["mode"] == "degraded"
+        assert st["status"].startswith("DEGRADED")
+
+        # established flows keep ALLOW (no blanket deny) ...
+        t, vs, _ = _submit(disp, allowed)
+        assert t.error is None and (vs == 0).all()
+        # ... while a disallowed NEW flow stays denied
+        t, vd, _ = _submit(disp, records(8, 80, 41000))
+        assert t.error is None and (vd < 0).all()
+
+        # heal -> probe -> rebuild + drift-audit gate -> recovered
+        inj.heal()
+        time.sleep(0.1)
+        t, v2, _ = _submit(disp, allowed)
+        assert t.error is None and (v2 == 0).all()
+        assert sup.mode == "ok"
+        assert DATAPLANE_RECOVERIES.total() > rec_before
+        st = d.status()["dataplane"]
+        assert st["mode"] == "ok" and st["status"] == "ok"
+        # the gate really ran the drift audit
+        assert d.drift_report() is not None
+        assert d.drift_report()["status"] in ("ok", "idle")
+    finally:
+        d.shutdown()

@@ -246,6 +246,141 @@ def test_closed_dispatcher_fails_closed_immediately():
     assert t.error is not None
 
 
+# --------------------------------------------- admission control (shed)
+
+def test_bounded_queue_sheds_overflow_fail_closed():
+    """The pending queue is weight-bounded: overflow is shed at
+    submit time with a ShedError (reason "overflow") and a real deny
+    result — never queued, never dispatched."""
+    from cilium_tpu.datapath.serving import ShedError
+    release = threading.Event()
+
+    def slow_launch(items, total):
+        release.wait(5.0)
+        return list(items)
+
+    disp = ContinuousDispatcher(
+        slow_launch, lambda h, w: [True] * len(h),
+        deny=lambda item: False, max_batch=4, max_pending=8,
+        lane="shed-ovl")
+    try:
+        tickets = [disp.submit(i) for i in range(64)]
+        shed = [t for t in tickets if isinstance(t.error, ShedError)]
+        assert shed and all(t.error.reason == "overflow"
+                            and t.value is False for t in shed)
+        # the bound held: never more than max_pending queued
+        assert disp.max_pending_seen <= 8
+        release.set()
+        accepted = [t for t in tickets if t.error is None
+                    or not isinstance(t.error, ShedError)]
+        for t in accepted:
+            assert t.result(timeout=30) is True
+        assert disp.stats()["shed"]["overflow"] == len(shed)
+    finally:
+        release.set()
+        disp.close()
+
+
+def test_expired_deadline_sheds_at_drain_time():
+    from cilium_tpu.datapath.serving import ShedError
+    gate = threading.Event()
+
+    def gated_launch(items, total):
+        gate.wait(5.0)
+        return list(items)
+
+    disp = ContinuousDispatcher(
+        gated_launch, lambda h, w: [True] * len(h),
+        deny=lambda item: False, max_batch=2, lane="shed-dl")
+    try:
+        head = disp.submit("head")          # occupies the dispatcher
+        doomed = [disp.submit(i, deadline=0.01) for i in range(8)]
+        time.sleep(0.05)                    # let the deadlines lapse
+        gate.set()
+        assert head.result(timeout=30) is True
+        shed = [t for t in doomed
+                if isinstance(t.error, ShedError)
+                and t.error.reason == "deadline"]
+        for t in doomed:
+            t.result(timeout=30)
+        assert shed, "expired work must be shed, not dispatched"
+        assert all(t.value is False for t in shed)
+    finally:
+        gate.set()
+        disp.close()
+
+
+def test_overload_watermark_hysteresis():
+    """The dataplane_overloaded gauge flips at the high watermark and
+    clears only at the low watermark (hysteresis, no flapping)."""
+    from cilium_tpu.utils.metrics import DATAPLANE_OVERLOADED
+    release = threading.Event()
+
+    def slow_launch(items, total):
+        release.wait(10.0)
+        return list(items)
+
+    disp = ContinuousDispatcher(
+        slow_launch, lambda h, w: [True] * len(h),
+        deny=lambda item: False, max_batch=1, max_pending=100,
+        overload_high=0.5, overload_low=0.1, lane="hyst")
+    try:
+        tickets = [disp.submit(i) for i in range(80)]
+        assert disp.overloaded                      # >= 50 queued
+        assert DATAPLANE_OVERLOADED.value(
+            labels={"lane": "hyst"}) == 1.0
+        release.set()
+        for t in tickets:
+            t.result(timeout=60)
+        deadline = time.monotonic() + 10
+        while disp.overloaded and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not disp.overloaded                  # drained past low
+        assert DATAPLANE_OVERLOADED.value(
+            labels={"lane": "hyst"}) == 0.0
+    finally:
+        release.set()
+        disp.close()
+
+
+def test_verdict_batcher_pushes_back_when_overloaded():
+    """VerdictBatcher.check answers an immediate fail-closed deny
+    while its lane is overloaded instead of queuing more work."""
+    from cilium_tpu.l7.parser import VerdictBatcher
+    release = threading.Event()
+
+    def slow_check(items):
+        release.wait(5.0)
+        return [True] * len(items)
+
+    async def run():
+        vb = VerdictBatcher(slow_check, max_wait=0.0, max_batch=2,
+                            max_pending=4, name="vb-push")
+        try:
+            # wedge the lane: two launches in flight, the completion
+            # blocked in slow_check — nothing drains anymore
+            head = [asyncio.ensure_future(vb.check(i))
+                    for i in range(3)]
+            await asyncio.sleep(0.05)
+            # now fill the queue behind the blocked lane
+            fill = [asyncio.ensure_future(vb.check(100 + i))
+                    for i in range(3)]
+            await asyncio.sleep(0.05)
+            assert vb.overloaded            # >= high watermark queued
+            pushed_back = await vb.check("late")
+            assert pushed_back is False     # immediate deny, no queue
+            release.set()
+            results = await asyncio.gather(*(head + fill))
+            # everything accepted before overload resolved honestly
+            assert all(results)
+            return True
+        finally:
+            release.set()
+            vb.close()
+
+    assert asyncio.run(run())
+
+
 # ------------------------------------------------- lock convoy + stages
 
 def test_lock_wait_no_longer_dominates_under_concurrent_callers():

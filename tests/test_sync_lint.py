@@ -22,6 +22,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # whole modules on the steady-state dispatch path
 HOT_MODULES = (
     "cilium_tpu/datapath/serving.py",
+    "cilium_tpu/datapath/supervisor.py",
     "cilium_tpu/verdict_service.py",
     "cilium_tpu/l7/parser.py",
 )
