@@ -130,6 +130,37 @@ def _smoke_result():
                                     "4x": leg(1004.0, 0.0, 664832)}},
                   "admission_bounds_queue": True,
                   "admission_p99_bounded_2x": True}}
+    # the mesh-shard config's pinned output schema: mesh geometry, a
+    # beyond-reference capacity leg, and a shard-kill degradation leg
+    suite["mesh-shard"] = {
+        "metric": "mesh_shard_verdicts_per_sec", "value": 720_000,
+        "unit": "verdicts/s", "vs_baseline": 0.072,
+        "extra": {"smoke": True,
+                  "mesh": {"devices": 8, "dp": 2, "ep": 4},
+                  "capacity": {
+                      "policy_endpoints": 1024,
+                      "entries_per_endpoint": 16384,
+                      "policy_entries": 16_777_216,
+                      "ipcache_entries": 578_048,
+                      "beyond_reference": {
+                          "reference_policy_entries": 8_388_608,
+                          "reference_ipcache_entries": 512_000,
+                          "policy": True, "ipcache": True},
+                      "per_mesh_verdicts_per_sec": 720_000,
+                      "batch_per_shard": 65536,
+                      "policy_build_seconds": 15.0,
+                      "ipcache_build_seconds": 9.0,
+                      "policy_device_mbytes_per_shard": 340.0,
+                      "shard0_devices": [0, 4]},
+                  "degraded": {
+                      "killed_shard": 0, "killed_mode": "degraded",
+                      "healthy_verdicts_per_sec": 400_000,
+                      "one_shard_down_verdicts_per_sec": 120_000,
+                      "degraded_ratio": 0.3,
+                      "fail_static_records": 3072,
+                      "healthy_shards_stayed_closed": True,
+                      "frame_records": 1024},
+                  "at_full_capacity": True}}
     # the latency-tier config's pinned output schema: per-batch-size
     # sync vs serving p50/p99 plus the coalescing block
     suite["latency-tier"] = {
@@ -394,9 +425,9 @@ def run_bench():
         # latency-tier leads: the serving-path latency claim must
         # never be the config the time budget drops; overload rides
         # right behind it (the survivable-serving admission claim)
-        for name in ("latency-tier", "overload", "identity-l4",
-                     "http-regex", "kafka-acl", "fqdn", "capacity",
-                     "incremental", "flows-overhead",
+        for name in ("latency-tier", "overload", "mesh-shard",
+                     "identity-l4", "http-regex", "kafka-acl", "fqdn",
+                     "capacity", "incremental", "flows-overhead",
                      "tracing-overhead", "provenance-overhead"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"

@@ -1005,6 +1005,252 @@ def bench_overload(on_accel: bool):
              (adm2["accepted_p99_ms"] or 1e9) <= deadline_s * 1e3 * 4})
 
 
+def bench_mesh_shard(on_accel: bool, full_capacity: bool = False):
+    """Sharded-dataplane proof: the verdict tables distributed across
+    the (dp, ep) device mesh with per-shard fault domains
+    (parallel/sharded.py).
+
+    Two legs in one artifact:
+
+    - **capacity** — per-shard ipcache-LPM + bucket-verdict tables at
+      a TOTAL capacity strictly beyond the committed single-device
+      reference (16384x512 policy + 512k ipcache,
+      BENCH_CAPACITY_FULL_*), each shard's slice device_put onto its
+      own mesh column (tables replicated across the column's dp
+      devices, batches sharded across dp), all shards dispatched
+      concurrently -> a per-MESH verdicts/s number.
+    - **degraded** — the full fused ShardedDatapath pipeline with one
+      shard's device lane killed by a fatal injected fault: measured
+      throughput with every shard healthy vs one shard serving
+      fail-static from its host oracle while the others stay on
+      device (no global pause; their breakers never open).
+
+    CPU smoke runs scaled down unless ``--full-capacity``; needs >= 2
+    visible devices (run_suite forces an 8-device virtual host mesh
+    when the platform is CPU).
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.compiler.lpm import compile_lpm
+    from cilium_tpu.ops.bucket_ops import BucketVerdictEngine
+    from cilium_tpu.ops.lpm_ops import lpm_lookup
+    from cilium_tpu.parallel.mesh import (ep_submesh, make_mesh,
+                                          replicate, shard_batch)
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return _result(
+            "mesh_shard_verdicts_per_sec", 0.0, "verdicts/s",
+            10_000_000.0,
+            {"skipped": f"only {n_dev} device(s) visible; the sharded "
+                        "dataplane needs >= 2"})
+    n_ep = 4 if n_dev >= 4 and n_dev % 4 == 0 else 2
+    mesh = make_mesh(ep_parallel=n_ep)
+    dp_sz = mesh.devices.shape[0]
+    full = on_accel or full_capacity
+
+    # ---- capacity leg: strictly beyond the single-device reference --
+    total_endpoints = 1024 if full else 64
+    eps_per_shard = total_endpoints // n_ep
+    entries_per_ep = 16_384 if full else 512
+    n_ipcache = 576_000 if full else 32_768
+    batch = (1 << 16) if full else (1 << 13)
+
+    rng = np.random.default_rng(41)
+    n32 = n_ipcache - 2048
+    addrs = (np.uint32(0x0A000000) +
+             rng.choice(np.uint32(1 << 24), n32, replace=False)) \
+        .astype(np.uint32)
+    prefixes = {}
+    for a in addrs:
+        prefixes[f"{a >> 24}.{(a >> 16) & 255}.{(a >> 8) & 255}"
+                 f".{a & 255}/32"] = int(256 + (a % (1 << 22)))
+    for i in range(1024):
+        prefixes[f"172.{i % 16 + 16}.{i // 16}.0/24"] = 256 + i
+        prefixes[f"{i % 223 + 1}.{i // 223}.0.0/16"] = 1280 + i
+    t0 = _time.perf_counter()
+    compiled = compile_lpm(prefixes)
+    ipcache_build_s = _time.perf_counter() - t0
+    lpm_host = (jnp.asarray(compiled.masks), jnp.asarray(compiled.key_a),
+                jnp.asarray(compiled.key_b), jnp.asarray(compiled.value),
+                jnp.asarray(compiled.prefix_lens))
+    probe = max(1, compiled.max_probe)
+
+    engines, lpm_dev, traffic = [], [], []
+    policy_build_s = 0.0
+    policy_entries = 0
+    for k in range(n_ep):
+        sub = ep_submesh(mesh, k)
+        rep = replicate(sub)
+        rng_k = np.random.default_rng(100 + k)
+        ident, meta, ep_col, tables, build_s = _make_policy_tables(
+            rng_k, eps_per_shard, entries_per_ep)
+        policy_build_s += build_s
+        policy_entries += tables.entry_count()
+        engines.append(BucketVerdictEngine(tables, device=rep))
+        # the replicated ipcache: every shard's column holds a copy
+        # (any shard's packets may reference any address)
+        lpm_dev.append(tuple(jax.device_put(a, rep) for a in lpm_host))
+        # this shard's traffic: half installed keys, half strangers,
+        # batch-sharded across the column's dp devices
+        sel = rng_k.integers(0, ident.size, batch)
+        hit = rng_k.random(batch) < 0.5
+        saddr = np.where(hit, addrs[rng_k.integers(0, n32, batch)],
+                         rng_k.integers(0, 1 << 32, batch)
+                         .astype(np.uint32)).view(np.int32)
+        args = {
+            "saddr": saddr,
+            "pep": ep_col[sel].astype(np.int32),
+            "pid": ident.ravel()[sel].view(np.int32),
+            "dpt": (meta.ravel()[sel] >> 16).astype(np.int32),
+            "proto": np.full(batch, 6, np.int32),
+            "direction": np.zeros(batch, np.int32),
+            "length": np.full(batch, 256, np.int32)}
+        traffic.append(shard_batch(sub, args, batch=batch))
+
+    def launch(k):
+        t = traffic[k]
+        found, looked = lpm_lookup(*lpm_dev[k], t["saddr"], probe)
+        use_id = jnp.where(found, looked, t["pid"])
+        return engines[k](t["pep"], use_id, t["dpt"], t["proto"],
+                          t["direction"], t["length"])
+
+    jax.block_until_ready([launch(k) for k in range(n_ep)])  # compile
+    iters = 8 if full else 4
+    t0 = _time.perf_counter()
+    outs = [launch(k) for _ in range(iters) for k in range(n_ep)]
+    jax.block_until_ready(outs)
+    cap_s = _time.perf_counter() - t0
+    per_mesh_vps = iters * n_ep * batch / cap_s
+    shard0_devices = sorted(
+        d.id for d in engines[0].key_id.sharding.device_set)
+
+    capacity = {
+        "policy_endpoints": total_endpoints,
+        "entries_per_endpoint": entries_per_ep,
+        "policy_entries": policy_entries,
+        "ipcache_entries": len(prefixes),
+        "beyond_reference": {
+            "reference_policy_entries": 8_388_608,
+            "reference_ipcache_entries": 512_000,
+            "policy": policy_entries > 8_388_608,
+            "ipcache": len(prefixes) > 512_000},
+        "per_mesh_verdicts_per_sec": round(per_mesh_vps),
+        "batch_per_shard": batch,
+        "policy_build_seconds": round(policy_build_s, 2),
+        "ipcache_build_seconds": round(ipcache_build_s, 2),
+        "policy_device_mbytes_per_shard": round(
+            engines[0].nbytes() / 1e6, 1),
+        "shard0_devices": shard0_devices,
+    }
+    del engines, lpm_dev, traffic
+
+    # ---- degraded leg: kill one shard of the full fused pipeline ---
+    from collections import deque
+
+    from bench import build_config1
+    from cilium_tpu.parallel.sharded import ShardedDatapath
+    from cilium_tpu.utils.faultinject import DeviceFaultInjector
+
+    states, cfg_prefixes = build_config1(
+        n_rules=100 if full else 40, n_endpoints=8 * n_ep)
+    plane = ShardedDatapath(mesh=mesh, ct_slots=1 << 14)
+    plane.telemetry_enabled = False
+    # long reset: the killed shard must STAY degraded through the
+    # measurement (no half-open probe mid-leg)
+    plane.configure_supervision(enabled=True, failure_threshold=1,
+                                reset_s=600.0)
+    plane.load_policy(states, revision=1,
+                      ipcache_prefixes=cfg_prefixes)
+    lane = plane.serving()
+    rng = np.random.default_rng(43)
+    frame = 1024 if full else 512
+    n_eps = len(states)
+
+    def chunk():
+        # equal per-shard split (endpoint stripes across all slots) so
+        # every frame packs to ONE bucket geometry per shard — a
+        # ragged split would hit fresh XLA bucket compiles mid-
+        # measurement and time the compiler, not the dataplane
+        return {
+            "endpoint": (np.arange(frame) % n_eps).astype(np.int32),
+            "saddr": rng.integers(0, 1 << 32, frame,
+                                  dtype=np.uint32).view(np.int32),
+            "daddr": rng.integers(0, 1 << 32, frame,
+                                  dtype=np.uint32).view(np.int32),
+            "sport": rng.integers(1024, 64000, frame).astype(np.int32),
+            "dport": rng.integers(1, 65536, frame).astype(np.int32),
+            "proto": np.full(frame, 6, np.int32),
+            "direction": np.ones(frame, np.int32),
+            "tcp_flags": np.full(frame, 0x02, np.int32),
+            "is_fragment": np.zeros(frame, np.int32),
+            "length": np.full(frame, 256, np.int32)}
+
+    pool = [chunk() for _ in range(16)]
+
+    # pre-warm every packed-bucket geometry coalescing can reach on
+    # each shard (frame/ep per chunk, up to ~5 chunks deep) so neither
+    # leg pays a fresh XLA compile inside its measurement — the same
+    # guard the overload config uses
+    rows = frame // n_ep
+    while rows <= (frame // n_ep) * 8:
+        for sh_eng in plane.shards:
+            v, _e, _i, _n = sh_eng.process_packed(
+                np.zeros((10, rows), np.int32))
+            jax.block_until_ready(v)
+        rows *= 2
+
+    def run_frames(n_frames):
+        tickets = deque()
+        t0 = _time.perf_counter()
+        for i in range(n_frames):
+            tickets.append(lane.submit_records(pool[i % 16], frame))
+            if len(tickets) > 4:
+                tickets.popleft().result(timeout=600)
+        while tickets:
+            tickets.popleft().result(timeout=600)
+        return n_frames * frame / (_time.perf_counter() - t0)
+
+    run_frames(4)  # compile + settle every shard's packed program
+    healthy_vps = run_frames(24 if full else 12)
+
+    killed = 0
+    sup = lane.lanes[killed].supervisor
+    sup.oracle.refresh()
+    inj = DeviceFaultInjector()
+    sup.install_fault_hook(inj)
+    inj.fail_launch(times=1, fatal=True)
+    kill = pool[0].copy()
+    kill["endpoint"] = np.full(frame, killed, np.int32)
+    lane.submit_records(kill, frame).result(timeout=600)
+    degraded_vps = run_frames(12 if full else 6)
+    others_closed = all(
+        lane.lanes[k].supervisor.breaker.state == "closed"
+        for k in range(n_ep) if k != killed)
+    degraded = {
+        "killed_shard": killed,
+        "killed_mode": sup.mode,
+        "healthy_verdicts_per_sec": round(healthy_vps),
+        "one_shard_down_verdicts_per_sec": round(degraded_vps),
+        "degraded_ratio": round(degraded_vps / healthy_vps, 3),
+        "fail_static_records": sup.fail_static_records,
+        "healthy_shards_stayed_closed": others_closed,
+        "frame_records": frame,
+    }
+    lane.close()
+
+    return _result(
+        "mesh_shard_verdicts_per_sec", per_mesh_vps, "verdicts/s",
+        10_000_000.0,
+        {"mesh": {"devices": n_dev, "dp": dp_sz, "ep": n_ep},
+         "capacity": capacity,
+         "degraded": degraded,
+         "at_full_capacity": bool(full)})
+
+
 CONFIGS = {
     "identity-l4": bench_identity_l4,
     "http-regex": bench_http_regex,
@@ -1017,18 +1263,29 @@ CONFIGS = {
     "provenance-overhead": bench_provenance_overhead,
     "latency-tier": bench_latency_tier,
     "overload": bench_overload,
+    "mesh-shard": bench_mesh_shard,
 }
 
 
 def run_suite():
-    from cilium_tpu.utils.platform import apply_env_platform
-    _backend, on_accel = apply_env_platform()
+    import os
     args = sys.argv[1:]
     full_capacity = "--full-capacity" in args
     wanted = [a for a in args if not a.startswith("--")] or list(CONFIGS)
+    if "mesh-shard" in wanted and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # the mesh-shard config needs a multi-device backend; on a
+        # single-chip/CPU box, force an 8-device virtual host mesh
+        # BEFORE jax initializes (same as tests/conftest.py).  The
+        # flag only affects the CPU platform — harmless on real TPU.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()
+    from cilium_tpu.utils.platform import apply_env_platform
+    _backend, on_accel = apply_env_platform()
     for name in wanted:
-        if name == "capacity":
-            r = bench_capacity(on_accel, full_capacity=full_capacity)
+        if name in ("capacity", "mesh-shard"):
+            r = CONFIGS[name](on_accel, full_capacity=full_capacity)
         else:
             r = CONFIGS[name](on_accel)
         print(json.dumps(r))

@@ -127,6 +127,10 @@ def cmd_status(c: Client, args) -> int:
               f"{tr['watch-relists']} relists, "
               f"{len(open_breakers)} breakers open")
     dp_state = st.get("dataplane") or {}
+    geom = dp_state.get("geometry")
+    if geom:
+        print(f"Dataplane:     sharded (dp={geom['dp']}, "
+              f"ep={geom['ep']}, {geom['devices']} devices)")
     if dp_state.get("mode", "ok") != "ok":
         # the loudest line status can carry: the device lane is down
         # and traffic is being served fail-static from the host oracle
@@ -151,6 +155,15 @@ def cmd_status(c: Client, args) -> int:
             else:
                 print(f"Map:           {name:14s} "
                       f"{m['occupied']} entries")
+        # sharded dataplane: per-shard occupancy of the bounded
+        # tables (CT/policy/flows) — the shard-local view the warn
+        # threshold is applied to
+        for shard, rep in sorted((mp.get("shards") or {}).items()):
+            for name, m in sorted((rep.get("maps") or {}).items()):
+                if m.get("pressure") is not None:
+                    print(f"Map[s{shard}]:       {name:14s} "
+                          f"{m['occupied']}/{m['capacity']} "
+                          f"({m['pressure'] * 100:.1f}%)")
         tel = st.get("telemetry") or {}
         jit = tel.get("jit") or {}
         if jit:

@@ -77,7 +77,18 @@ class Daemon:
         self.proxy = ProxyManager(self.config.proxy_port_min,
                                   self.config.proxy_port_max)
         self.controllers = ControllerManager()
-        self.datapath = Datapath(ct_slots=self.config.ct_slots)
+        # the verdict dataplane: single-engine by default; with
+        # dataplane_shards >= 2 the full fused pipeline shards across
+        # the (dp, ep) device mesh — endpoint-axis table slices with
+        # per-shard CT/flow state and per-shard fault domains
+        # (parallel/sharded.py)
+        if self.config.dataplane_shards >= 2:
+            from ..parallel.sharded import ShardedDatapath
+            self.datapath = ShardedDatapath(
+                n_shards=self.config.dataplane_shards,
+                ct_slots=self.config.ct_slots)
+        else:
+            self.datapath = Datapath(ct_slots=self.config.ct_slots)
         # runtime self-telemetry (observability/): span tracing across
         # the control plane, the policy-propagation latency tracker
         # closed by the engine's revision-served hook, and the
@@ -107,8 +118,15 @@ class Daemon:
             default_deadline=self.config.serving_deadline_s or None)
         # incremental policy realization: one endpoint's regeneration
         # writes one device-table row (syncPolicyMap analog); the
-        # engine re-jits only when the stack's geometry grows
-        self.table_mgr = DeviceTableManager()
+        # engine re-jits only when the stack's geometry grows.  In
+        # sharded mode the row write (and any grow/re-jit) touches
+        # ONLY the owning shard's slice.
+        if self.config.dataplane_shards >= 2:
+            from ..parallel.sharded import ShardedTableManager
+            self.table_mgr = ShardedTableManager(
+                self.config.dataplane_shards)
+        else:
+            self.table_mgr = DeviceTableManager()
         self.datapath.use_table_manager(self.table_mgr)
         # host fast path: C++ per-endpoint verdict caches (the eBPF
         # hit-path analog); optional — the TPU path works without it
@@ -1254,6 +1272,20 @@ class Daemon:
         mode = out.get("mode", "ok")
         if mode == "ok":
             out["status"] = "ok"
+        elif "shards" in out:
+            # sharded dataplane: name EXACTLY the degraded shards —
+            # the rest of the mesh is still serving bit-exact on
+            # device, and the operator must see the blast radius
+            bad = out.get("degraded-shards", [])
+            faults = []
+            for k in bad:
+                sup = ((out["shards"].get(str(k)) or {})
+                       .get("serving") or {}).get("supervisor") or {}
+                faults.append(f"shard {k}: {sup.get('last-fault')}")
+            out["status"] = (
+                f"{mode.upper()}: shard(s) {bad} serving fail-static "
+                f"from the host oracle ({'; '.join(faults)}); "
+                f"remaining shards on device")
         else:
             sup = (out.get("serving") or {}).get("supervisor") or {}
             out["status"] = (

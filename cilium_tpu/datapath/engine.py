@@ -117,6 +117,15 @@ class Datapath:
         # the shared continuous micro-batching dispatcher
         # (datapath/serving.py), created on first use
         self._serving = None
+        self._serving_lane_name = "verdict"
+        # mesh placement (parallel/): when set, every device table this
+        # engine owns is resident on the given (dp, 1) submesh — one
+        # shard's column of the dataplane mesh — and packed batches are
+        # sharded across its dp axis.  None = single-device (default).
+        self._placement = None
+        self._batch_sharding = None
+        self._replicated_sharding = None
+        self.shard_index: Optional[int] = None
         # host-of-record policy states (load_policy mode) — what the
         # fail-static oracle and the recovery gate answer from when no
         # DeviceTableManager owns the tensors
@@ -238,6 +247,47 @@ class Datapath:
         return echo_reply(words, ipv6_to_words(requester_ip6),
                           ident=ident, seq=seq)
 
+    def set_mesh_placement(self, submesh, shard: Optional[int] = None,
+                           lane: Optional[str] = None) -> None:
+        """Pin this engine's device state to a (dp, ep=1) submesh — one
+        shard column of the dataplane mesh (parallel/mesh.ep_submesh).
+
+        Tables/CT/counters/flows are device_put replicated across the
+        column's dp devices; packed serving batches are sharded across
+        dp (pjit follows the committed input shardings), so the shard's
+        compiled program spans exactly its own devices — its fault
+        domain.  Must be called before tables are loaded or it re-jits.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import DP_AXIS
+        with self._lock:
+            self._placement = submesh
+            self._batch_sharding = NamedSharding(submesh,
+                                                 P(None, DP_AXIS))
+            self._replicated_sharding = NamedSharding(submesh, P())
+            self.shard_index = shard
+            if lane is not None:
+                self._serving_lane_name = lane
+            elif shard is not None:
+                self._serving_lane_name = f"verdict-s{shard}"
+            self._place_state_locked()
+            if self._step is not None:
+                self._rebuild()
+
+    def _place_state_locked(self) -> None:
+        """device_put the mutable per-shard state (CT, flows) onto the
+        placement submesh (lock held).  Async transfers; donation keeps
+        subsequent step outputs resident there."""
+        rep = self._replicated_sharding
+        if rep is None:
+            return
+        self.ct.state = jax.device_put(self.ct.state, rep)
+        self.ct6.state = jax.device_put(self.ct6.state, rep)
+        if self.flows is not None:
+            self.flows.state = jax.device_put(self.flows.state, rep)
+        if self.counters is not None:
+            self.counters = jax.device_put(self.counters, rep)
+
     # -- table loading -------------------------------------------------------
 
     def load_policy(self, map_states: Sequence[PolicyMapState],
@@ -292,6 +342,10 @@ class Datapath:
                 self._rebuild(mgr_snapshot=(geometry, tensors))
                 return True
             key_id, key_meta, value = tensors
+            if self._placement is not None:
+                key_id, key_meta, value = jax.device_put(
+                    (key_id, key_meta, value),
+                    self._replicated_sharding)
             dp = self._tables.datapath._replace(
                 key_id=key_id, key_meta=key_meta, value=value)
             self._tables = self._tables._replace(datapath=dp)
@@ -527,6 +581,15 @@ class Datapath:
                               **flow_kwargs, flow_claim_budget=0),
             donate_argnums=(1, 2))
 
+        # mesh placement: commit every table onto this shard's column
+        # submesh so the jitted steps compile as submesh-resident SPMD
+        # programs (the batch axis shards across dp at dispatch time)
+        if self._placement is not None:
+            rep = self._replicated_sharding
+            self._tables = jax.device_put(self._tables, rep)
+            self._tables6 = jax.device_put(self._tables6, rep)
+            self.counters = jax.device_put(self.counters, rep)
+
     # -- the hot path --------------------------------------------------------
 
     def _flow_step_variant(self, step, step_nc):
@@ -643,6 +706,11 @@ class Datapath:
         telem = self.telemetry_enabled
         t0 = time.perf_counter() if telem else 0.0
         ts = self._timestamp(now)
+        if self._placement is not None and \
+                packed.shape[1] % self._placement.devices.shape[0] == 0:
+            # shard the batch axis across the submesh's dp devices
+            # (async H2D; the jitted step follows committed shardings)
+            packed = jax.device_put(packed, self._batch_sharding)
         with self._lock:
             if self._step_packed is None:
                 raise RuntimeError("no policy loaded")
@@ -714,7 +782,8 @@ class Datapath:
                     from .supervisor import DeviceSupervisor
                     supervisor = DeviceSupervisor(self, **cfg)
                 self._serving = VerdictDispatcher(
-                    self, supervisor=supervisor, **admission)
+                    self, supervisor=supervisor,
+                    lane=self._serving_lane_name, **admission)
             return self._serving
 
     def supervision_status(self) -> Dict:

@@ -52,7 +52,9 @@ import numpy as np
 from ..utils.faultinject import DeviceLaneFault
 from ..utils.metrics import (DATAPLANE_DEVICE_FAULTS,
                              DATAPLANE_FAIL_STATIC, DATAPLANE_MODE,
-                             DATAPLANE_RECOVERIES)
+                             DATAPLANE_RECOVERIES,
+                             DATAPLANE_SHARD_FAULTS,
+                             DATAPLANE_SHARD_MODE)
 from ..utils.resilience import (STATE_CLOSED, STATE_HALF_OPEN,
                                 CircuitBreaker)
 from .pipeline import WORLD_IDENTITY, host_fail_static_step
@@ -322,15 +324,23 @@ class DeviceSupervisor:
                  new_flow_policy: str = "oracle",
                  recovery_gate: Optional[Callable[[], bool]] = None,
                  oracle_refresh_s: float = 5.0,
-                 gate_samples: int = 32):
+                 gate_samples: int = 32,
+                 shard: Optional[int] = None):
         self.datapath = datapath
         self.watchdog_s = watchdog_s
         self.oracle_refresh_s = oracle_refresh_s
         self.gate_samples = gate_samples
+        # shard scoping (parallel/sharded.py): this supervisor guards
+        # ONE ep-shard's device column — its breaker, watchdog, fault
+        # accounting and fail-static fallback cover only endpoints
+        # mapped to that shard; sibling shards keep serving on device
+        self.shard = shard
+        self._name = "dataplane" if shard is None else \
+            f"dataplane-shard{shard}"
         self.oracle = HostStaticOracle(datapath,
                                        new_flow_policy=new_flow_policy)
         self.breaker = CircuitBreaker(
-            "dataplane", failure_threshold=failure_threshold,
+            self._name, failure_threshold=failure_threshold,
             reset_timeout=reset_s, max_reset=max_reset_s)
         self._recovery_gate = recovery_gate
         self._hook = None  # chaos hand: utils/faultinject injector
@@ -338,7 +348,7 @@ class DeviceSupervisor:
         self._probing = False
         self._refreshing = threading.Lock()
         self._mode = MODE_OK
-        DATAPLANE_MODE.set(0.0)
+        self._set_mode_gauge(0.0)
         # observability
         self.fail_static_batches = 0
         self.fail_static_records = 0
@@ -350,7 +360,11 @@ class DeviceSupervisor:
 
     def install_fault_hook(self, hook) -> None:
         """Arm a DeviceFaultInjector (utils/faultinject) — the chaos
-        hand's device-lane entry point."""
+        hand's device-lane entry point.  The injector inherits this
+        supervisor's shard scope: its faults land on exactly this
+        shard's launches/finalizes."""
+        if hasattr(hook, "shard"):
+            hook.shard = self.shard
         self._hook = hook
 
     # ------------------------------------------------------------ mode
@@ -364,11 +378,20 @@ class DeviceSupervisor:
             return MODE_RECOVERING
         return MODE_DEGRADED
 
+    def _set_mode_gauge(self, code: float) -> None:
+        if self.shard is None:
+            DATAPLANE_MODE.set(code)
+        else:
+            # shard-scoped lanes report per shard; the aggregate
+            # dataplane_mode is maintained by the sharded plane
+            DATAPLANE_SHARD_MODE.set(code,
+                                     labels={"shard": str(self.shard)})
+
     def _sync_mode(self) -> None:
         mode = self.mode
         if mode != self._mode:
             self._mode = mode
-            DATAPLANE_MODE.set(float(_MODE_CODE[mode]))
+            self._set_mode_gauge(float(_MODE_CODE[mode]))
 
     # --------------------------------------------------------- dispatch
 
@@ -416,7 +439,7 @@ class DeviceSupervisor:
             self._on_success()
             return True, results
         if self._runner is None or self._runner.abandoned:
-            self._runner = _WatchdogRunner("dataplane-watchdog")
+            self._runner = _WatchdogRunner(f"{self._name}-watchdog")
         status, payload = self._runner.run(run, self.watchdog_s)
         if status == "ok":
             self._on_success()
@@ -440,6 +463,9 @@ class DeviceSupervisor:
         self.last_fault = f"{stage}: {e!r}"
         DATAPLANE_DEVICE_FAULTS.inc(labels={"stage": stage,
                                             "kind": kind})
+        if self.shard is not None:
+            DATAPLANE_SHARD_FAULTS.inc(
+                labels={"shard": str(self.shard), "kind": kind})
         if kind == "transient":
             self.breaker.record_failure()
         else:
@@ -480,7 +506,7 @@ class DeviceSupervisor:
                 self._refreshing.release()
 
         threading.Thread(target=run, daemon=True,
-                         name="dataplane-oracle-refresh").start()
+                         name=f"{self._name}-oracle-refresh").start()
 
     # ------------------------------------------------------ fail-static
 
@@ -563,6 +589,7 @@ class DeviceSupervisor:
 
     def stats(self) -> Dict:
         return {"mode": self.mode,
+                "shard": self.shard,
                 "breaker": self.breaker.state,
                 "probe-in": round(self.breaker.retry_in(), 3),
                 "faults": dict(self.faults),

@@ -27,6 +27,17 @@ MAP_PRESSURE = registry.gauge(
 MAP_ENTRIES = registry.gauge(
     "map_entries",
     "Live entries per device table by map")
+# Sharded-dataplane twins (parallel/sharded.py): per-shard occupancy so
+# a single shard's CT/flow/policy table filling up is visible as that
+# shard's pressure, not averaged away across the mesh — the warn
+# threshold applies shard-locally.
+MAP_SHARD_PRESSURE = registry.gauge(
+    "map_shard_pressure",
+    "Fill fraction (0..1) of fixed-capacity device tables by map and "
+    "dataplane shard")
+MAP_SHARD_ENTRIES = registry.gauge(
+    "map_shard_entries",
+    "Live entries per device table by map and dataplane shard")
 
 DEFAULT_WARN_THRESHOLD = 0.9
 
@@ -38,23 +49,35 @@ def _bounded(occupied: int, capacity: int) -> float:
 
 
 def compute_pressure(inventory: Dict[str, Dict],
-                     warn_threshold: float = DEFAULT_WARN_THRESHOLD
-                     ) -> Dict:
+                     warn_threshold: float = DEFAULT_WARN_THRESHOLD,
+                     shard: "int | None" = None) -> Dict:
     """Pressure report from a ``map_inventory()`` dict.  Updates the
     gauges as a side effect (the /metrics view and this report can
-    never disagree)."""
+    never disagree).
+
+    With ``shard`` set, the report covers ONE dataplane shard: gauges
+    go to the shard-labelled series and warnings name the shard — the
+    warn threshold is applied shard-locally, because a full table on
+    shard k is shard k's emergency even when the mesh-wide average
+    looks healthy."""
     maps: Dict[str, Dict] = {}
     warnings: List[str] = []
+    if shard is None:
+        pressure_g, entries_g, labels, prefix = \
+            MAP_PRESSURE, MAP_ENTRIES, {}, ""
+    else:
+        pressure_g, entries_g = MAP_SHARD_PRESSURE, MAP_SHARD_ENTRIES
+        labels, prefix = {"shard": str(shard)}, f"shard {shard}: "
 
     def add(name: str, occupied: int, capacity: int) -> None:
         p = _bounded(occupied, capacity)
         maps[name] = {"occupied": occupied, "capacity": capacity,
                       "pressure": p}
-        MAP_PRESSURE.set(p, labels={"map": name})
-        MAP_ENTRIES.set(float(occupied), labels={"map": name})
+        pressure_g.set(p, labels={"map": name, **labels})
+        entries_g.set(float(occupied), labels={"map": name, **labels})
         if capacity > 0 and p >= warn_threshold:
             warnings.append(
-                f"{name}: {occupied}/{capacity} "
+                f"{prefix}{name}: {occupied}/{capacity} "
                 f"({p * 100:.1f}% >= {warn_threshold * 100:.0f}%)")
 
     for name in ("ct", "ct6"):
@@ -81,13 +104,16 @@ def compute_pressure(inventory: Dict[str, Dict],
             n = int(entry.get("entries", 0))
             maps[name] = {"occupied": n, "capacity": None,
                           "pressure": None}
-            MAP_ENTRIES.set(float(n), labels={"map": name})
+            entries_g.set(float(n), labels={"map": name, **labels})
     for name, key in (("lb", "services"), ("lb6", "services")):
         entry = inventory.get(name)
         if entry is not None:
             n = int(entry.get(key, 0))
             maps[name] = {"occupied": n, "capacity": None,
                           "pressure": None}
-            MAP_ENTRIES.set(float(n), labels={"map": name})
-    return {"maps": maps, "warnings": warnings,
-            "warn-threshold": warn_threshold}
+            entries_g.set(float(n), labels={"map": name, **labels})
+    out = {"maps": maps, "warnings": warnings,
+           "warn-threshold": warn_threshold}
+    if shard is not None:
+        out["shard"] = shard
+    return out

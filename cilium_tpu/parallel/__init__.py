@@ -1,12 +1,21 @@
-"""Mesh/sharding helpers: how the datapath scales over TPU chips.
+"""Mesh/sharding: how the verdict dataplane scales over TPU chips.
 
 The reference scales per-packet work across CPUs/NICs (per-CPU BPF maps,
 RSS) and across nodes via kvstore replication. Here the analogs are:
   * ``dp`` mesh axis — the packet batch is sharded across chips (ICI);
-  * ``ep`` mesh axis — stacked per-endpoint policy tables can shard
-    across chips when the table set outgrows one chip's HBM;
+  * ``ep`` mesh axis — the stacked per-endpoint policy tables shard
+    across chips, one slice + fault domain per shard
+    (``sharded.ShardedDatapath``);
   * control-plane replication (kvstore) stays host-side over DCN.
+
+``specs.py`` is the canonical PartitionSpec registry for every device
+table leaf (lint-enforced); ``sharded.py`` is the sharded dataplane
+with per-shard supervisors and partial-mesh survival.
 """
 
-from .mesh import (make_mesh, shard_batch, replicate, batch_sharding,
-                   table_sharding)
+from .mesh import (DP_AXIS, EP_AXIS, batch_sharding, ep_submesh,
+                   make_mesh, packed_batch_sharding, replicate,
+                   shard_batch, table_sharding)
+from .sharded import (ShardedDatapath, ShardedServingLane,
+                      ShardedTableManager, ShardedTicket, global_slot,
+                      local_slot, shard_of_slot)

@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional
 
 import jax
 import numpy as np
@@ -17,19 +17,45 @@ def make_mesh(n_devices: Optional[int] = None,
     """A (dp, ep) mesh over the first ``n_devices`` devices.
 
     ``ep_parallel`` splits devices between batch parallelism and endpoint
-    table sharding; default keeps everything on the dp axis.
+    table sharding; default keeps everything on the dp axis.  Asking for
+    more devices than the backend exposes is an error, never a silent
+    under-provision: a dataplane that believes it spans N fault domains
+    but actually spans fewer would mis-scope every per-shard decision.
     """
-    devs = jax.devices()[:n_devices] if n_devices else jax.devices()
+    avail = jax.devices()
+    if n_devices is not None and n_devices > len(avail):
+        raise ValueError(
+            f"requested {n_devices} devices but only {len(avail)} "
+            f"available")
+    devs = avail[:n_devices] if n_devices else avail
     n = len(devs)
-    if n % ep_parallel != 0:
+    if ep_parallel < 1 or n % ep_parallel != 0:
         raise ValueError(f"{n} devices not divisible by ep={ep_parallel}")
     arr = np.array(devs).reshape(n // ep_parallel, ep_parallel)
     return Mesh(arr, axis_names=(DP_AXIS, EP_AXIS))
 
 
+def ep_submesh(mesh: Mesh, shard: int) -> Mesh:
+    """Shard ``shard``'s (dp, 1) column submesh: the devices that hold
+    that shard's endpoint-table slice.  Each shard's compiled program
+    spans exactly its own column, so a device loss in one column is a
+    single-shard fault domain, not a whole-mesh outage."""
+    n_ep = mesh.devices.shape[1]
+    if not 0 <= shard < n_ep:
+        raise ValueError(f"shard {shard} out of range for ep={n_ep}")
+    return Mesh(mesh.devices[:, shard:shard + 1],
+                axis_names=(DP_AXIS, EP_AXIS))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """[B, ...] tensors: shard the batch across dp, replicate across ep."""
     return NamedSharding(mesh, P(DP_AXIS))
+
+
+def packed_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[F, B] packed field matrices (pipeline.PACKED_FIELDS rows):
+    shard the batch axis (axis 1) across dp."""
+    return NamedSharding(mesh, P(None, DP_AXIS))
 
 
 def table_sharding(mesh: Mesh) -> NamedSharding:
@@ -41,7 +67,29 @@ def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, tree):
-    """Place every [B]-leading leaf with batch sharding."""
+def shard_batch(mesh: Mesh, tree, batch: Optional[int] = None):
+    """Place [B]-leading leaves with batch sharding, everything else
+    replicated.
+
+    ``batch`` names B explicitly; when omitted it is inferred from the
+    first array leaf's leading dimension.  Only leaves whose leading
+    dimension equals B (and divides evenly across dp) are sharded —
+    scalars, tables and oddly-shaped leaves are replicated onto the
+    mesh instead of being sliced along the wrong axis.
+    """
+    leaves = [x for x in jax.tree.leaves(tree)
+              if getattr(x, "ndim", 0) >= 1]
+    if batch is None:
+        if not leaves:
+            return tree
+        batch = int(np.shape(leaves[0])[0])
+    dp = mesh.devices.shape[0]
     sh = batch_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    rep = replicate(mesh)
+
+    def place(x):
+        nd = getattr(x, "ndim", 0)
+        if nd >= 1 and int(np.shape(x)[0]) == batch and batch % dp == 0:
+            return jax.device_put(x, sh)
+        return jax.device_put(x, rep)
+    return jax.tree.map(place, tree)

@@ -1,0 +1,167 @@
+"""Canonical shard-spec registry: every device-table leaf's logical
+PartitionSpec over the (dp, ep) mesh.
+
+This is the single source of truth for how the dataplane's device
+state distributes across the mesh — the analog of the reference's
+per-CPU/per-node map ownership rules.  Policy tables shard their
+endpoint axis across ``ep``; the mutable per-shard state (conntrack,
+flow aggregation, counters) is shard-LOCAL — logically stacked along
+``ep``, physically resident only on its owning shard's (dp, 1) column
+submesh — and the address-keyed lookup tables (ipcache, LB, prefilter,
+tunnel) are replicated per shard because any shard's packets may
+reference any address.
+
+``tests/test_sharding_lint.py`` holds the registry complete: a new
+``FullTables``/CT/flow-table leaf without a declared spec here is a
+test failure, not a silent default-to-replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DP_AXIS, EP_AXIS
+
+# shorthand specs (the logical layout over the FULL (dp, ep) mesh)
+EP_ROWS = P(EP_AXIS, None)          # [E, S]: endpoint axis across ep
+EP_VEC = P(EP_AXIS)                 # [E]: endpoint axis across ep
+SHARD_LOCAL = P(EP_AXIS, None)      # logically [ep, ...]: one copy per
+#                                     shard, resident on its column
+REPLICATED = P()                    # every shard holds a full copy
+BATCH = P(DP_AXIS)                  # [B] packet-batch leaves
+PACKED_BATCH = P(None, DP_AXIS)     # [F, B] packed field matrices
+
+
+# ---------------------------------------------------------------------------
+# The registry: {table class name: {leaf path: PartitionSpec}}.
+# Nested NamedTuples use dotted paths (FullTables.datapath.key_id ->
+# "datapath.key_id").
+# ---------------------------------------------------------------------------
+
+DATAPATH_TABLES_SPECS: Dict[str, P] = {
+    "key_id": EP_ROWS, "key_meta": EP_ROWS, "value": EP_ROWS,
+    "lpm_masks": REPLICATED, "lpm_key_a": REPLICATED,
+    "lpm_key_b": REPLICATED, "lpm_value": REPLICATED,
+    "lpm_plens": REPLICATED,
+}
+
+LB_TABLES_SPECS: Dict[str, P] = {
+    "svc_key_a": REPLICATED, "svc_key_b": REPLICATED,
+    "svc_value": REPLICATED, "svc_count": REPLICATED,
+    "svc_offset": REPLICATED, "svc_revnat": REPLICATED,
+    "b_addr": REPLICATED, "b_port": REPLICATED,
+    "rev_vip": REPLICATED, "rev_port": REPLICATED,
+}
+
+LPM6_TABLES_SPECS: Dict[str, P] = {
+    "masks": REPLICATED, "k0": REPLICATED, "k1": REPLICATED,
+    "k2": REPLICATED, "k3": REPLICATED, "kb": REPLICATED,
+    "value": REPLICATED, "plens": REPLICATED,
+}
+
+LB6_TABLES_SPECS: Dict[str, P] = {
+    "svc_k0": REPLICATED, "svc_k1": REPLICATED, "svc_k2": REPLICATED,
+    "svc_k3": REPLICATED, "svc_kb": REPLICATED,
+    "svc_value": REPLICATED, "svc_count": REPLICATED,
+    "svc_offset": REPLICATED, "svc_revnat": REPLICATED,
+    "b_addr": REPLICATED, "b_port": REPLICATED,
+    "rev_vip": REPLICATED, "rev_port": REPLICATED,
+}
+
+FULL_TABLES_SPECS: Dict[str, P] = {
+    **{f"datapath.{k}": v for k, v in DATAPATH_TABLES_SPECS.items()},
+    **{f"lb.{k}": v for k, v in LB_TABLES_SPECS.items()},
+    "pf_masks": REPLICATED, "pf_key_a": REPLICATED,
+    "pf_key_b": REPLICATED, "pf_value": REPLICATED,
+    "pf_plens": REPLICATED,
+    "tun_masks": REPLICATED, "tun_key_a": REPLICATED,
+    "tun_key_b": REPLICATED, "tun_value": REPLICATED,
+    "tun_plens": REPLICATED,
+    "ep_identity": EP_VEC,
+}
+
+FULL_TABLES6_SPECS: Dict[str, P] = {
+    "key_id": EP_ROWS, "key_meta": EP_ROWS, "value": EP_ROWS,
+    **{f"ipcache6.{k}": v for k, v in LPM6_TABLES_SPECS.items()},
+    **{f"pf6.{k}": v for k, v in LPM6_TABLES_SPECS.items()},
+    **{f"lb6.{k}": v for k, v in LB6_TABLES_SPECS.items()},
+    "router_ip6": REPLICATED,
+    "ep_identity": EP_VEC,
+}
+
+# mutable per-shard state: every leaf lives on its owning shard alone
+CT_STATE_SPECS: Dict[str, P] = {
+    "k0": SHARD_LOCAL, "k1": SHARD_LOCAL, "k2": SHARD_LOCAL,
+    "k3": SHARD_LOCAL, "expires": SHARD_LOCAL, "state": SHARD_LOCAL,
+    "rev_nat": SHARD_LOCAL, "proxy_port": SHARD_LOCAL,
+}
+
+FLOW_STATE_SPECS: Dict[str, P] = {
+    "keys": SHARD_LOCAL, "counters": SHARD_LOCAL,
+    "lost": SHARD_LOCAL, "updates": SHARD_LOCAL,
+}
+
+COUNTERS_SPECS: Dict[str, P] = {
+    "packets": SHARD_LOCAL, "bytes": SHARD_LOCAL,
+}
+
+
+def _table_classes():
+    from ..datapath.conntrack import CTState
+    from ..datapath.lb import LB6Tables, LBTables
+    from ..datapath.pipeline import (DatapathTables, FullTables,
+                                     FullTables6, LPM6Tables)
+    from ..datapath.verdict import Counters
+    from ..hubble.aggregation import FlowState
+    return {
+        DatapathTables: DATAPATH_TABLES_SPECS,
+        LBTables: LB_TABLES_SPECS,
+        LPM6Tables: LPM6_TABLES_SPECS,
+        LB6Tables: LB6_TABLES_SPECS,
+        FullTables: FULL_TABLES_SPECS,
+        FullTables6: FULL_TABLES6_SPECS,
+        CTState: CT_STATE_SPECS,
+        FlowState: FLOW_STATE_SPECS,
+        Counters: COUNTERS_SPECS,
+    }
+
+
+def leaf_paths(cls: Type, nested: Dict[str, Type]) -> List[str]:
+    """Dotted leaf paths of a NamedTuple table class, recursing into
+    fields named in ``nested`` (field name -> NamedTuple class)."""
+    out: List[str] = []
+    for field in cls._fields:
+        sub = nested.get(field)
+        if sub is not None:
+            out.extend(f"{field}.{p}"
+                       for p in leaf_paths(sub, nested))
+        else:
+            out.append(field)
+    return out
+
+
+def registry() -> Dict[str, Dict[str, P]]:
+    """{table class name: specs} for every registered device table."""
+    return {cls.__name__: specs
+            for cls, specs in _table_classes().items()}
+
+
+def missing_specs() -> Dict[str, List[str]]:
+    """Leaves present on a registered table class but absent from its
+    spec table (the sharding lint's subject — must be empty)."""
+    from ..datapath.lb import LB6Tables, LBTables
+    from ..datapath.pipeline import DatapathTables, LPM6Tables
+    nested_by_cls = {
+        "FullTables": {"datapath": DatapathTables, "lb": LBTables},
+        "FullTables6": {"ipcache6": LPM6Tables, "pf6": LPM6Tables,
+                        "lb6": LB6Tables},
+    }
+    out: Dict[str, List[str]] = {}
+    for cls, specs in _table_classes().items():
+        nested = nested_by_cls.get(cls.__name__, {})
+        missing = [p for p in leaf_paths(cls, nested) if p not in specs]
+        if missing:
+            out[cls.__name__] = missing
+    return out

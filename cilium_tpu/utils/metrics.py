@@ -285,6 +285,18 @@ DATAPLANE_FAIL_STATIC = registry.counter(
     "dataplane_fail_static_verdicts_total",
     "Verdicts served from the host fail-static oracle while the "
     "device lane is degraded")
+# Per-shard fault-domain series (parallel/sharded.py): when the verdict
+# dataplane is sharded across the device mesh, each ep-shard is its own
+# fault domain with its own breaker — these series carry the shard
+# index so a single-shard failure is visible as exactly that.
+DATAPLANE_SHARD_MODE = registry.gauge(
+    "dataplane_shard_mode",
+    "Per-shard dataplane serving mode (0 ok / 1 degraded / "
+    "2 recovering), by shard index")
+DATAPLANE_SHARD_FAULTS = registry.counter(
+    "dataplane_shard_faults_total",
+    "Device-lane faults absorbed by a shard-scoped supervisor, by "
+    "shard index and kind")
 PROXY_REDIRECTS = registry.gauge(
     "proxy_redirects", "Number of active proxy redirects")
 PROXY_UPSTREAM_TIME = registry.histogram(

@@ -276,6 +276,14 @@ class DaemonConfig:
     # first half-open probe delay; doubles per failed probe up to
     # the resilience layer's max_reset
     supervisor_reset_s: float = 1.0
+    # shard the verdict dataplane across the device mesh
+    # (parallel/sharded.py): >= 2 builds a (dp, ep=dataplane_shards)
+    # mesh over the visible devices, shards the endpoint axis of the
+    # policy tables across ep with per-shard CT/flow state and
+    # per-shard fault domains (a device fault degrades ONE shard to
+    # fail-static while the rest keep serving on device).  0/1 = the
+    # single-engine dataplane.  Device count must divide evenly.
+    dataplane_shards: int = 0
     kvstore: str = "memory"
     kvstore_opts: Dict[str, str] = field(default_factory=dict)
     # runtime-mutable option map shared by new endpoints

@@ -57,7 +57,7 @@ def test_smoke_final_line_parses_and_fits(tmp_path):
     suite = extra["suite"]
     for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
                  "capacity", "incremental", "latency-tier",
-                 "overload"):
+                 "overload", "mesh-shard"):
         assert name in suite, f"{name} missing from compact suite"
         assert "value" in suite[name]
         assert "vs_baseline" in suite[name]
@@ -103,6 +103,23 @@ def test_smoke_writes_full_result_file(tmp_path):
                 assert key in row, (leg_name, mult, key)
     assert "admission_bounds_queue" in ovl["extra"]
     assert "admission_p99_bounded_2x" in ovl["extra"]
+    # the mesh-shard schema is pinned: mesh geometry, the
+    # beyond-reference capacity leg, and the shard-kill degraded leg
+    ms = res["extra"]["suite_configs"]["mesh-shard"]
+    assert ms["unit"] == "verdicts/s"
+    for key in ("devices", "dp", "ep"):
+        assert key in ms["extra"]["mesh"], key
+    cap = ms["extra"]["capacity"]
+    for key in ("policy_entries", "ipcache_entries",
+                "per_mesh_verdicts_per_sec", "beyond_reference",
+                "policy_build_seconds", "shard0_devices"):
+        assert key in cap, key
+    deg = ms["extra"]["degraded"]
+    for key in ("killed_shard", "healthy_verdicts_per_sec",
+                "one_shard_down_verdicts_per_sec",
+                "fail_static_records",
+                "healthy_shards_stayed_closed"):
+        assert key in deg, key
     # and the committed on-accel artifact is embedded here, not inline
     assert "last_on_accel" in res["extra"]
     assert res["extra"]["last_on_accel"]["result"]["value"]
@@ -149,6 +166,35 @@ def test_compact_line_keeps_gates_and_suite_when_small():
     assert out["extra"]["suite"]["broken"].startswith("failed")
     assert out["extra"]["p99_b256_us"]["host"] == 30.0
     assert out["extra"]["full"] == "BENCH_FULL_x.json"
+
+
+def test_committed_multichip_artifact_is_real():
+    """The committed MULTICHIP artifact must be the real mesh-shard
+    bench (per-mesh verdicts/s at a capacity strictly beyond the
+    single-device reference, plus a shard-kill degradation leg) — not
+    the old rc/ok smoke."""
+    import glob
+    files = sorted(glob.glob(os.path.join(REPO,
+                                          "MULTICHIP_FULL_*.json")))
+    assert files, "no committed MULTICHIP_FULL_*.json artifact"
+    doc = json.load(open(files[-1]))
+    res = doc["result"]
+    assert res["metric"] == "mesh_shard_verdicts_per_sec"
+    mesh = res["extra"]["mesh"]
+    assert mesh["devices"] >= 2 and mesh["ep"] >= 2
+    cap = res["extra"]["capacity"]
+    # strictly beyond the committed single-device reference
+    # (BENCH_CAPACITY_FULL_*: 16384x512 policy + 512k ipcache)
+    assert cap["policy_entries"] > 8_388_608
+    assert cap["ipcache_entries"] > 512_000
+    assert cap["beyond_reference"]["policy"] is True
+    assert cap["beyond_reference"]["ipcache"] is True
+    assert cap["per_mesh_verdicts_per_sec"] > 0
+    deg = res["extra"]["degraded"]
+    assert deg["one_shard_down_verdicts_per_sec"] > 0
+    assert deg["fail_static_records"] > 0
+    assert deg["healthy_shards_stayed_closed"] is True
+    assert deg["killed_mode"] == "degraded"
 
 
 @pytest.mark.parametrize("flag", [True, False])

@@ -1,0 +1,77 @@
+"""Sharding-spec lint: every device-table leaf has a declared
+PartitionSpec in the canonical registry (parallel/specs.py).
+
+The failure mode this guards: someone adds a leaf to ``FullTables``
+(or the CT/flow state) and it silently defaults to replicated —
+correct on one device, a capacity/memory lie on the mesh, and invisible
+until a shard OOMs.  A new leaf without a registry entry is a test
+failure, not a review nit.  The registry is also checked against
+reality the other way: specs naming leaves that no longer exist are
+stale docs and fail too.
+"""
+
+from jax.sharding import PartitionSpec
+
+from cilium_tpu.parallel import specs
+from cilium_tpu.parallel.mesh import DP_AXIS, EP_AXIS
+
+
+def test_every_table_leaf_has_a_declared_spec():
+    missing = specs.missing_specs()
+    assert not missing, (
+        "device-table leaves without a declared PartitionSpec in "
+        "parallel/specs.py (new leaves must not silently default to "
+        f"replicated): {missing}")
+
+
+def test_no_stale_spec_entries():
+    from cilium_tpu.datapath.lb import LB6Tables, LBTables
+    from cilium_tpu.datapath.pipeline import DatapathTables, LPM6Tables
+    nested = {
+        "FullTables": {"datapath": DatapathTables, "lb": LBTables},
+        "FullTables6": {"ipcache6": LPM6Tables, "pf6": LPM6Tables,
+                        "lb6": LB6Tables},
+    }
+    stale = {}
+    for cls, table in specs._table_classes().items():
+        paths = set(specs.leaf_paths(cls,
+                                     nested.get(cls.__name__, {})))
+        extra = sorted(set(table) - paths)
+        if extra:
+            stale[cls.__name__] = extra
+    assert not stale, f"specs name leaves that no longer exist: {stale}"
+
+
+def test_registry_covers_the_core_tables():
+    reg = specs.registry()
+    for name in ("FullTables", "FullTables6", "DatapathTables",
+                 "CTState", "FlowState", "Counters"):
+        assert name in reg, f"{name} missing from the spec registry"
+
+
+def test_specs_are_partition_specs_over_known_axes():
+    for name, table in specs.registry().items():
+        for leaf, spec in table.items():
+            assert isinstance(spec, PartitionSpec), (name, leaf)
+            for axis in spec:
+                if axis is None:
+                    continue
+                axes = axis if isinstance(axis, tuple) else (axis,)
+                for a in axes:
+                    assert a in (DP_AXIS, EP_AXIS), \
+                        f"{name}.{leaf} uses unknown mesh axis {a!r}"
+
+
+def test_policy_tables_shard_endpoint_axis():
+    """The tentpole invariant: the stacked policy tables' endpoint
+    axis shards across ep (per-unit state residency), and the mutable
+    CT/flow state is shard-local, never dp-sharded."""
+    full = specs.FULL_TABLES_SPECS
+    for leaf in ("datapath.key_id", "datapath.key_meta",
+                 "datapath.value"):
+        assert full[leaf] == specs.EP_ROWS, leaf
+    assert full["ep_identity"] == specs.EP_VEC
+    for leaf, spec in specs.CT_STATE_SPECS.items():
+        assert spec == specs.SHARD_LOCAL, leaf
+    for leaf, spec in specs.FLOW_STATE_SPECS.items():
+        assert spec == specs.SHARD_LOCAL, leaf

@@ -25,6 +25,12 @@ HOT_MODULES = (
     "cilium_tpu/datapath/supervisor.py",
     "cilium_tpu/verdict_service.py",
     "cilium_tpu/l7/parser.py",
+    # the sharded dataplane's routing/fan-out path: splitting and
+    # reassembly must never sync — each shard's lane owns its one
+    # flagged "complete" boundary
+    "cilium_tpu/parallel/mesh.py",
+    "cilium_tpu/parallel/specs.py",
+    "cilium_tpu/parallel/sharded.py",
 )
 
 # the engine is hot only in its dispatch functions — table loading,
