@@ -161,6 +161,25 @@ def _smoke_result():
                       "healthy_shards_stayed_closed": True,
                       "frame_records": 1024},
                   "at_full_capacity": True}}
+    # the control-churn config's pinned output schema: three legs
+    # (healthy / outage / reconnect) with journal depth, reconcile
+    # time, and regenerations avoided vs a naive full resync
+    suite["control-churn"] = {
+        "metric": "control_churn_ops_per_sec", "value": 5,
+        "unit": "ops/s", "vs_baseline": 0.1,
+        "extra": {"smoke": True, "endpoints": 20,
+                  "legs": {
+                      "healthy": {"churn_ops_per_sec": 5.2},
+                      "outage": {"churn_ops_per_sec": 9.9,
+                                 "journal_depth": 4,
+                                 "local_identities": 4,
+                                 "staleness_seconds": 2.0},
+                      "reconnect": {
+                          "reconcile_seconds": 3.4,
+                          "journal_replayed": 4, "repaired": 0,
+                          "promoted": 4, "regenerations": 4,
+                          "naive_full_resync_regens": 20,
+                          "regenerations_avoided": 16}}}}
     # the latency-tier config's pinned output schema: per-batch-size
     # sync vs serving p50/p99 plus the coalescing block
     suite["latency-tier"] = {
@@ -426,6 +445,7 @@ def run_bench():
         # never be the config the time budget drops; overload rides
         # right behind it (the survivable-serving admission claim)
         for name in ("latency-tier", "overload", "mesh-shard",
+                     "control-churn",
                      "identity-l4", "http-regex", "kafka-acl", "fqdn",
                      "capacity", "incremental", "flows-overhead",
                      "tracing-overhead", "provenance-overhead"):

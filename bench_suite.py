@@ -1005,6 +1005,151 @@ def bench_overload(on_accel: bool):
              (adm2["accepted_p99_ms"] or 1e9) <= deadline_s * 1e3 * 4})
 
 
+def bench_control_churn(on_accel: bool):
+    """Control-plane churn/outage macro-bench: endpoint add/remove +
+    rule changes against a LIVE daemon with kvstore survivability, in
+    three legs — healthy (1x), during an etcd blackhole (outage), and
+    across the reconnect (reconcile).  Reports churn throughput per
+    leg, the degraded-mode journal depth, reconcile time (journal
+    replay + local-key repair + identity promotion), and regenerations
+    during the reconnect vs the naive full-resync storm (every
+    endpoint rebuilt) that the delta-apply promotion path avoids."""
+    import time as _time
+
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.kvstore.etcd import EtcdBackend
+    from cilium_tpu.kvstore.mini_etcd import MiniEtcd
+    from cilium_tpu.labels import Labels, parse_label
+    from cilium_tpu.policy.jsonio import rules_from_json
+    from cilium_tpu.utils.faultinject import (ControlPlaneFaultInjector,
+                                              FaultProxy)
+    from cilium_tpu.utils.metrics import POLICY_REGENERATION_COUNT
+    from cilium_tpu.utils.option import DaemonConfig
+
+    srv = MiniEtcd(reap_interval=0.2).start()
+    proxy = FaultProxy("127.0.0.1", srv.port).start()
+    inj = ControlPlaneFaultInjector(etcd=proxy,
+                                    lease_expirer=srv.expire_leases)
+    kv = EtcdBackend(host="127.0.0.1", port=proxy.port,
+                     lease_ttl=30.0, timeout=0.5)
+    cfg = DaemonConfig(state_dir="", drift_audit_interval_s=0,
+                       ct_checkpoint_interval_s=0, enable_hubble=False,
+                       enable_tracing=False,
+                       enable_kvstore_survival=True,
+                       kvstore_probe_interval_s=0.05,
+                       kvstore_failure_threshold=2,
+                       kvstore_reconcile_ops_per_s=0.0)
+    d = Daemon(config=cfg, kvstore_backend=kv, node_name="bench")
+
+    def _rule(name, port):
+        return rules_from_json(json.dumps([{
+            "endpointSelector": {"matchLabels": {"id": name}},
+            "ingress": [{"toPorts": [{"ports": [
+                {"port": str(port), "protocol": "TCP"}]}]}],
+            "labels": [f"k8s:bench={name}"]}]))
+
+    n_base = 16 if not on_accel else 32
+    try:
+        # prime: a base endpoint population + per-endpoint rules
+        for k in range(n_base):
+            d.endpoint_create(1000 + k, ipv4=f"10.200.2.{k + 1}",
+                              labels=[f"k8s:id=base{k}"])
+        base_rules = []
+        for k in range(n_base):
+            base_rules.extend(_rule(f"base{k}", 5000 + k))
+        rev = d.policy_add(base_rules)
+        assert d.wait_for_policy_revision(rev, timeout=300)
+
+        def churn(leg, cycles, eid0):
+            """One churn unit = endpoint create (new labels) + rule
+            add + rule delete + endpoint delete; returns ops/s."""
+            t0 = _time.perf_counter()
+            ops = 0
+            for k in range(cycles):
+                eid = eid0 + k
+                d.endpoint_create(eid, ipv4=f"10.201.{leg}.{k + 1}",
+                                  labels=[f"k8s:id=leg{leg}n{k}"])
+                d.policy_add(_rule(f"leg{leg}n{k}", 6000 + k))
+                d.policy_delete(Labels.from_labels(
+                    [parse_label(f"k8s:bench=leg{leg}n{k}")]))
+                d.endpoint_delete(eid)
+                ops += 4
+            d.wait_for_quiesce(120)
+            return ops / (_time.perf_counter() - t0)
+
+        # ---- leg 1: healthy churn ----
+        healthy_ops = churn(1, 6 if not on_accel else 12, 2000)
+
+        # ---- leg 2: churn during an etcd blackhole ----
+        inj.blackhole("etcd")
+        deadline = _time.perf_counter() + 30
+        while d.status()["kvstore"]["mode"] != "degraded":
+            if _time.perf_counter() > deadline:
+                raise RuntimeError("never degraded")
+            _time.sleep(0.02)
+        # outage churn: creates STAY (their local identities are what
+        # the reconnect must promote); rules churn add/delete
+        t0 = _time.perf_counter()
+        n_outage = 4 if not on_accel else 8
+        ops = 0
+        for k in range(n_outage):
+            d.endpoint_create(3000 + k, ipv4=f"10.202.0.{k + 1}",
+                              labels=[f"k8s:id=out{k}"])
+            d.policy_add(_rule(f"out{k}", 7000 + k))
+            ops += 2
+        d.wait_for_quiesce(120)
+        outage_ops = ops / (_time.perf_counter() - t0)
+        st = d.status()["kvstore"]
+        journal_depth = st["journal-depth"]
+        local_idents = st["local-identities"]
+        staleness = st["staleness-seconds"]
+
+        # ---- leg 3: reconnect reconcile + promotion ----
+        regen_before = POLICY_REGENERATION_COUNT.total()
+        t0 = _time.perf_counter()
+        inj.heal()
+        deadline = _time.perf_counter() + 120
+        while _time.perf_counter() < deadline:
+            st = d.status()["kvstore"]
+            if st["mode"] == "ok" and st["local-identities"] == 0:
+                break
+            _time.sleep(0.02)
+        d.wait_for_quiesce(120)
+        reconcile_s = _time.perf_counter() - t0
+        # settle: the promotion queues its bounded regenerations just
+        # after the last local identity is released — let them land
+        # before counting
+        _time.sleep(0.5)
+        d.wait_for_quiesce(120)
+        regens = int(POLICY_REGENERATION_COUNT.total() - regen_before)
+        rec = st["last-reconcile"] or {}
+        n_endpoints = len(d.endpoints)
+        naive = n_endpoints  # full resync rebuilds every endpoint
+        return _result(
+            "control_churn_ops_per_sec", healthy_ops, "ops/s", 50.0,
+            {"endpoints": n_endpoints,
+             "legs": {
+                 "healthy": {"churn_ops_per_sec": round(healthy_ops, 1)},
+                 "outage": {"churn_ops_per_sec": round(outage_ops, 1),
+                            "journal_depth": journal_depth,
+                            "local_identities": local_idents,
+                            "staleness_seconds": staleness},
+                 "reconnect": {
+                     "reconcile_seconds": round(reconcile_s, 3),
+                     "journal_replayed": rec.get("replayed", 0),
+                     "repaired": rec.get("repaired", 0),
+                     "promoted": local_idents,
+                     "regenerations": regens,
+                     "naive_full_resync_regens": naive,
+                     "regenerations_avoided": max(0, naive - regens)}}})
+    finally:
+        d.shutdown()
+        kv.close()
+        inj.close()
+        proxy.close()
+        srv.shutdown()
+
+
 def bench_mesh_shard(on_accel: bool, full_capacity: bool = False):
     """Sharded-dataplane proof: the verdict tables distributed across
     the (dp, ep) device mesh with per-shard fault domains
@@ -1264,6 +1409,7 @@ CONFIGS = {
     "latency-tier": bench_latency_tier,
     "overload": bench_overload,
     "mesh-shard": bench_mesh_shard,
+    "control-churn": bench_control_churn,
 }
 
 

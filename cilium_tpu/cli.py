@@ -100,6 +100,20 @@ def cmd_status(c: Client, args) -> int:
         return 0
     kv = st["kvstore"]
     print(f"KVStore:       {kv['state']} ({kv['backend']})")
+    if kv.get("mode") and kv["mode"] != "ok":
+        # the control plane is down: the agent is pinning
+        # last-known-good state and journaling mutations for replay
+        print(f"KVStore:       {kv['mode'].upper()}: pinned "
+              f"last-known-good (staleness "
+              f"{kv.get('staleness-seconds', 0)}s, journal "
+              f"{kv.get('journal-depth', 0)} queued, breaker "
+              f"{kv.get('breaker')}, "
+              f"{kv.get('local-identities', 0)} local identities)")
+    elif kv.get("staleness-seconds", 0) > 0:
+        print(f"KVStore:       STALE: {kv['staleness-seconds']}s since "
+              f"last successful op "
+              f"({kv.get('consecutive-failures', 0)} consecutive "
+              f"failures, breaker {kv.get('breaker')})")
     print(f"Policy:        revision {st['policy']['revision']}, "
           f"{st['policy']['rules']} rules")
     eps = st["endpoints"]
@@ -118,6 +132,13 @@ def cmd_status(c: Client, args) -> int:
            if ctl["consecutive-failure-count"] > 0]
     print(f"Controllers:   {len(st.get('controllers', []))} "
           f"({len(bad)} failing)")
+    ch = st.get("controller-health") or {}
+    if ch.get("failing"):
+        # the loud top-level signal: a reconcile loop is wedged
+        print(f"Controllers:   {ch['status']}")
+        for f in ch["failing"]:
+            print(f"Controllers:     {f['name']}: "
+                  f"{f['consecutive-failures']}x — {f['last-error']}")
     tr = st.get("transports")
     if tr:
         open_breakers = [n for n, s in tr.get("breakers", {}).items()

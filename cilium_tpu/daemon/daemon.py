@@ -28,12 +28,18 @@ from ..datapath.engine import Datapath
 from ..datapath.lb import Backend, Service
 from ..endpoint import (DeviceTableManager, Endpoint, EndpointManager,
                         EndpointState)
-from ..identity import (Identity, IdentityCache, LocalIdentityAllocator)
-from ..ipcache import (SOURCE_AGENT_LOCAL, IPCache, IPIdentityWatcher,
-                       KVStoreIPCacheSyncer, allocate_cidr_identities,
-                       release_cidr_identities)
+from ..identity import (Identity, IdentityCache, LocalIdentityAllocator,
+                        is_local_scope_identity)
+from ..ipcache import (SOURCE_AGENT_LOCAL, SOURCE_GENERATED, IPCache,
+                       IPIdentityWatcher, KVStoreIPCacheSyncer,
+                       allocate_cidr_identities, release_cidr_identities)
+from ..ipcache.kvstore_sync import IP_IDENTITIES_PATH
 from ..kvstore import backend as kvbackend
-from ..kvstore.identity_allocator import DistributedIdentityAllocator
+from ..kvstore.identity_allocator import (IDENTITY_PREFIX,
+                                          DistributedIdentityAllocator,
+                                          FallbackIdentityAllocator)
+from ..kvstore.outage import OutageGuard
+from ..node.registry import NODES_PATH
 from ..ipam import HostScopeIPAM, IPAMError
 from ..l7.dns import DNSCache, DNSPoller, inject_to_cidr_set
 from ..labels import Labels
@@ -201,26 +207,63 @@ class Daemon:
             datapath=self.datapath)
 
         # identity allocation: distributed when a kvstore is attached
-        # (daemon.go:1295 InitIdentityAllocator)
+        # (daemon.go:1295 InitIdentityAllocator).  The backend is
+        # wrapped in the control-plane outage guard (kvstore/outage.py):
+        # pass-through bookkeeping by default (the status() staleness
+        # fix), full degrade/journal/reconcile machinery when
+        # enable_kvstore_survival is on.
+        self._kv_guard = None
+        # promotion-time identity events must not fan a regeneration
+        # storm across every endpoint; see _on_identity_change.  The
+        # id-keyed map outlives the time window because the watch echo
+        # of a promotion arrives only after the streams re-establish.
+        self._suppress_regen_until = 0.0
+        self._suppressed_ident_ids: Dict[int, float] = {}
+        if kvstore_backend is not None:
+            self._kv_guard = OutageGuard(
+                kvstore_backend,
+                degrade=self.config.enable_kvstore_survival,
+                failure_threshold=self.config.kvstore_failure_threshold,
+                probe_interval=self.config.kvstore_probe_interval_s,
+                grace_s=self.config.kvstore_grace_s,
+                journal_max=self.config.kvstore_journal_max,
+                replay_ops_per_s=self.config
+                .kvstore_reconcile_ops_per_s)
+            kvstore_backend = self._kv_guard
         self.kv = kvstore_backend
         if self.kv is not None:
             # remote identity churn must retrigger endpoint policy
             # recompute (pkg/identity identityWatcher ->
             # TriggerPolicyUpdates): a peer node allocating a new
             # identity changes what our selectors match
-            self.identity_allocator = DistributedIdentityAllocator(
+            allocator = DistributedIdentityAllocator(
                 self.kv, node=node_name,
                 cluster_id=self.config.cluster_id,
                 on_change=self._on_identity_change)
+            if self.config.enable_kvstore_survival:
+                # outage fallback: adopt cached bindings, else allocate
+                # node-local ephemeral identities promoted on reconnect
+                allocator = FallbackIdentityAllocator(
+                    allocator, guard=self._kv_guard,
+                    on_change=self._on_identity_change)
+            self.identity_allocator = allocator
             self._ip_syncer = KVStoreIPCacheSyncer(self.kv)
             self.ipcache.add_listener(self._ip_syncer.listener(),
                                       replay=False)
-            self._ip_watcher = IPIdentityWatcher(self.kv, self.ipcache)
+            self._ip_watcher = IPIdentityWatcher(
+                self.kv, self.ipcache,
+                restart=self.config.enable_kvstore_survival,
+                restart_backoff_s=self.config.kvstore_probe_interval_s)
             self._ip_watcher.start()
             self.node_registry = NodeRegistry(
                 self.kv,
                 on_node_update=self._on_node_update,
                 on_node_delete=self._on_node_delete)
+            # the reconnect relist-and-diff repairs locally owned keys
+            # under exactly the replicated-store prefixes
+            self._kv_guard.track_prefix(IDENTITY_PREFIX + "/")
+            self._kv_guard.track_prefix(IP_IDENTITIES_PATH + "/")
+            self._kv_guard.track_prefix(NODES_PATH + "/")
         else:
             self.identity_allocator = LocalIdentityAllocator(
                 cluster_id=self.config.cluster_id)
@@ -280,6 +323,16 @@ class Daemon:
         self.controllers.update_controller(
             "ct-gc", ControllerParams(
                 do_func=lambda: self.datapath.gc(), run_interval=5.0))
+        # the control-plane outage driver: probes the kvstore when
+        # idle, detects sustained failure, and on reconnect runs the
+        # journal replay + relist reconcile followed by local-identity
+        # promotion (opt-in; kvstore/outage.py)
+        if self._kv_guard is not None and \
+                self.config.enable_kvstore_survival:
+            self.controllers.update_controller(
+                "kvstore-outage", ControllerParams(
+                    do_func=self._kvstore_tick,
+                    run_interval=self.config.kvstore_probe_interval_s))
         # periodic CT checkpoint: a kill -9'd agent otherwise loses
         # every established flow (shutdown() is the only other writer)
         if self.config.state_dir and \
@@ -291,12 +344,147 @@ class Daemon:
 
     # ------------------------------------------------------------ nodes
 
-    def _on_identity_change(self, _typ: str, _ident) -> None:
+    def _on_identity_change(self, _typ: str, ident) -> None:
         # may fire during __init__ (watch replay) before the trigger
         # exists; those identities are covered by the first build anyway
+        now = time.monotonic()
+        if now < getattr(self, "_suppress_regen_until", 0.0):
+            # local-identity promotion window: the promotion path
+            # queues regeneration for exactly the affected endpoints —
+            # the watch echo of our own re-allocations must not fan a
+            # full regeneration storm on top of it
+            return
+        suppressed = getattr(self, "_suppressed_ident_ids", None)
+        if suppressed and ident is not None:
+            until = suppressed.get(getattr(ident, "id", None))
+            if until is not None:
+                if now < until:
+                    # the watch echo of a promoted identity: streams
+                    # re-establish only after reconnect, so this event
+                    # lands well past the promotion window — still our
+                    # own re-allocation, still not a storm trigger
+                    return
+                suppressed.pop(ident.id, None)
         trigger = getattr(self, "_regen_trigger", None)
         if trigger is not None:
             trigger.trigger("identity-change")
+
+    # ------------------------------------- control-plane survivability
+
+    def _kvstore_tick(self) -> None:
+        """The kvstore-outage controller body: drive the outage
+        guard's detector/reconcile state machine, then promote any
+        node-local ephemeral identities once the control plane is
+        healthy again."""
+        guard = self._kv_guard
+        event = guard.tick()
+        if event.get("reconciled"):
+            self.monitor.notify_agent(
+                "kvstore-reconnected",
+                f"reconcile={event.get('report')}")
+        if guard.mode == "ok" and \
+                isinstance(self.identity_allocator,
+                           FallbackIdentityAllocator) and \
+                self.identity_allocator.local_count():
+            self._promote_local_identities()
+
+    def _promote_local_identities(self) -> Dict[str, int]:
+        """Re-key everything holding a node-local ephemeral identity
+        to a cluster-scope one through the (now healthy) distributed
+        allocator, regenerating ONLY the affected endpoints: the
+        re-keyed ones plus any endpoint whose realized policy map
+        references a promoted ID — incremental delta-applies, never a
+        full regeneration storm."""
+        fb = self.identity_allocator
+        mapping: Dict[int, int] = {}   # local id -> cluster id
+        # two suppression layers for the watch echo of our own
+        # re-allocations: a rolling time window (bumped per promoted
+        # identity — a slow kvstore must not outlive it mid-loop) and
+        # an id-keyed map (the echo can land only after the watch
+        # streams re-establish, well past any fixed window)
+        window = max(1.0, 4 * self.config.kvstore_probe_interval_s)
+        suppress_for = max(30.0,
+                           8 * self.config.kvstore_probe_interval_s)
+        self._suppress_regen_until = time.monotonic() + window
+
+        def _register(old_id: int, new_id: int) -> None:
+            mapping[old_id] = new_id
+            until = time.monotonic() + suppress_for
+            self._suppressed_ident_ids[old_id] = until
+            self._suppressed_ident_ids[new_id] = until
+            self._suppress_regen_until = time.monotonic() + window
+
+        promoted_cidrs = rekeyed = 0
+        try:
+            # policy-held CIDR identities first (prefix -> identity)
+            with self._lock:
+                local_cidrs = [
+                    (p, ident, n)
+                    for p, (ident, n) in self._cidr_idents.items()
+                    if is_local_scope_identity(ident.id)]
+            for prefix, old, refs in local_cidrs:
+                # keep the window alive across each kvstore round-trip
+                self._suppress_regen_until = time.monotonic() + window
+                new = None
+                for _ in range(refs):
+                    new, _is_new = fb.allocate(old.labels)
+                if new is None or is_local_scope_identity(new.id):
+                    continue  # control plane flapped again; next tick
+                _register(old.id, new.id)
+                with self._lock:
+                    self._cidr_idents[prefix] = (new, refs)
+                self.ipcache.upsert(prefix, new.id, SOURCE_GENERATED,
+                                    metadata="cidr-policy")
+                for _ in range(refs):
+                    fb.release(old)
+                promoted_cidrs += 1
+            # endpoint identities: re-resolve labels through the
+            # healthy allocator (the normal update path — allocate new,
+            # release local, device identity + ipcache in lockstep)
+            rekeyed_ids = []
+            for ep in self.endpoints.endpoints():
+                old_id = ep.security_identity
+                if not is_local_scope_identity(old_id):
+                    continue
+                self._suppress_regen_until = time.monotonic() + window
+                changed = ep.update_labels(fb, ep.labels)
+                if not changed or \
+                        is_local_scope_identity(ep.security_identity):
+                    continue
+                _register(old_id, ep.security_identity)
+                if ep.table_slot is not None:
+                    self.datapath.set_endpoint_identity(
+                        ep.table_slot, ep.security_identity)
+                if ep.ipv4:
+                    self.ipcache.upsert(ep.ipv4, ep.security_identity,
+                                        SOURCE_AGENT_LOCAL,
+                                        metadata=f"endpoint:{ep.id}")
+                rekeyed_ids.append(ep.id)
+                rekeyed += 1
+            # the actually-diverged endpoint set: re-keyed endpoints
+            # plus endpoints whose realized maps name a promoted ID
+            referencing = []
+            if mapping:
+                for ep in self.endpoints.endpoints():
+                    if ep.id in rekeyed_ids:
+                        continue
+                    state = PolicyMapState(ep.realized)
+                    if any(k.identity in mapping for k in state.keys()):
+                        referencing.append(ep.id)
+                for eid in rekeyed_ids + referencing:
+                    self.endpoints.queue_regeneration(eid)
+        finally:
+            IDENTITY_COUNT.set(len(self.identity_allocator))
+        report = {"promoted": len(mapping), "rekeyed": rekeyed,
+                  "cidrs": promoted_cidrs,
+                  "regenerated": rekeyed + len(referencing)
+                  if mapping else 0}
+        if mapping:
+            self.monitor.notify_agent(
+                "identity-promotion",
+                f"promoted={len(mapping)} rekeyed={rekeyed} "
+                f"regenerated={report['regenerated']}")
+        return report
 
     def _on_node_update(self, node: Node) -> None:
         self.node_manager.node_updated(node)
@@ -711,12 +899,24 @@ class Daemon:
         # tier must tell one story for identities with known labels
         sc_checked = 0
         cache = IdentityCache.snapshot(self.identity_allocator)
-        sc_idents = list(cache.items())
+        # reserved identities are excluded: their L3 entries can be
+        # installed by infrastructure, not selector policy (e.g. the
+        # reserved:host allow that rides along with any L7 redirect,
+        # mapstate.py LOCALHOST_KEY) — the label simulation would
+        # report false drift against them
+        sc_idents = [(n, la) for n, la in cache.items()
+                     if not idpkg.is_reserved_identity(n)]
         sc_idents = [sc_idents[i]
                      for i in rng.permutation(len(sc_idents))]
         for ep in eps[:4]:
-            if ep.policy_revision < self.repo.revision:
-                continue  # not yet regenerated against current rules
+            if ep.policy_revision != self.repo.revision:
+                # behind: not yet regenerated against current rules.
+                # AHEAD: restored from checkpoint while the repo is
+                # empty/older (the pinned-map window, daemon/state.go)
+                # — the realized state deliberately outlives the repo
+                # until re-import, so the label simulation would
+                # report false drift
+                continue
             cfg = ep.policy_config(self.config.always_allow_localhost())
             if not cfg.ingress_enforcement:
                 continue  # every identity legitimately gets an L3 key
@@ -735,7 +935,7 @@ class Daemon:
                 sc_checked += 1
                 if (decision == Decision.ALLOWED) != has_l3 or \
                         has_l3 != dev_l3:
-                    if ep.policy_revision < self.repo.revision:
+                    if ep.policy_revision != self.repo.revision:
                         continue  # regeneration raced the check
                     divergences.append({
                         "endpoint": ep.id,
@@ -1214,14 +1414,11 @@ class Daemon:
 
     def status(self) -> Dict:
         """GET /healthz (daemon/status.go status collector)."""
-        kv = "ok" if self.kv is None else self.kv.status()
         from .. import __version__
         return {
             "version": __version__,
             "uptime-seconds": round(time.time() - self.started_at, 3),
-            "kvstore": {"state": kv,
-                        "backend": "none" if self.kv is None else
-                        type(self.kv).__name__},
+            "kvstore": self._kvstore_status(),
             "policy": {"revision": self.repo.revision,
                        "rules": len(self.repo)},
             "endpoints": {
@@ -1233,6 +1430,10 @@ class Daemon:
             "proxy": {"redirects": len(self.proxy)},
             "clustermesh": self.clustermesh.status(),
             "controllers": self.controllers.status_model(),
+            # top-level controller degraded signal: a reconcile loop
+            # failing repeatedly must not stay buried inside the
+            # controller list (`cilium-tpu status` prints it loudly)
+            "controller-health": self._controller_health(),
             # breaker/retry/relist counters from the transport
             # resilience layer (utils/resilience.py) — the same series
             # /metrics exposes, summarized for the status path
@@ -1266,6 +1467,34 @@ class Daemon:
             # runtime capability probes (bpf/run_probes.sh analog)
             "features": self._features(),
         }
+
+    def _kvstore_status(self) -> Dict:
+        """status()["kvstore"]: no longer a bare echo of kv.status() —
+        the outage guard contributes breaker state and the
+        seconds-since-last-successful-op staleness age, so a dead
+        backend can never report 'ok' between calls; while degraded
+        the mode/staleness/journal fields ARE the loud signal."""
+        if self.kv is None:
+            return {"state": "ok", "backend": "none"}
+        inner = getattr(self.kv, "inner", self.kv)
+        out = {"state": self.kv.status(),
+               "backend": type(inner).__name__}
+        if self._kv_guard is not None:
+            out.update(self._kv_guard.report())
+            fb = self.identity_allocator
+            if isinstance(fb, FallbackIdentityAllocator):
+                out["local-identities"] = fb.local_count()
+                out["fallback-allocations"] = fb.fallback_allocations
+        return out
+
+    def _controller_health(self) -> Dict:
+        failing = self.controllers.failing()
+        if not failing:
+            return {"status": "ok", "failing": []}
+        names = ", ".join(f["name"] for f in failing)
+        return {"status": f"DEGRADED: controller(s) {names} failing "
+                          f">=3x consecutively",
+                "failing": failing}
 
     def _dataplane_status(self) -> Dict:
         out = self.datapath.supervision_status()

@@ -147,6 +147,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # host-timed stage slices + blocking boundaries
                 # (observability/stages.py pipeline_report)
                 return self._send(200, d.pipeline_report())
+            if path == "/debug/drift-audit" and method == "POST":
+                # on-demand drift-audit sweep (the periodic
+                # controller's body): replay sampled tuples through
+                # the live compiled tables vs the host oracles —
+                # restart/chaos journeys use this to prove the
+                # restored dataplane is bit-exact RIGHT NOW
+                return self._send(200, d.run_drift_audit())
             if path == "/debuginfo" and method == "GET":
                 # cilium debuginfo (cilium/cmd/debuginfo.go): one
                 # aggregate snapshot for bug reports / support

@@ -30,6 +30,20 @@ MAX_NUMERIC_IDENTITY = 65535
 # Cluster ID is encoded above bit 16 (reference: identity/allocator.go:93).
 CLUSTER_ID_SHIFT = 16
 
+# Node-local ephemeral identity scope (reference: identity.
+# IdentityScopeLocal — CIDR identities carry bit 24).  Identities
+# allocated here never leave the node: the kvstore-outage fallback
+# allocates endpoint identities from this range while the cluster
+# allocator is unreachable, and they are promoted to cluster-scope IDs
+# on reconnect (kvstore/identity_allocator.FallbackIdentityAllocator).
+LOCAL_SCOPE_IDENTITY_BASE = 1 << 24
+
+
+def is_local_scope_identity(numeric_id: int) -> bool:
+    """True for node-local ephemeral identities (never published to
+    the cluster; promoted to cluster scope on kvstore reconnect)."""
+    return numeric_id >= LOCAL_SCOPE_IDENTITY_BASE
+
 # Reserved numeric identities (reference: numericidentity.go:42-104).
 IDENTITY_UNKNOWN = 0
 RESERVED_HOST = 1

@@ -84,11 +84,23 @@ class IPIdentityWatcher:
     """Inbound: watch the kvstore prefix and ingest remote mappings.
 
     Reference: ipcache/kvstore.go IPIdentityWatcher.Watch.
+
+    With ``restart=True`` (the control-plane survivability mode) a
+    watch stream that ends without ``stop()`` — a kvstore outage on a
+    transport whose watchers don't self-heal — is re-established with
+    a fresh ``list_and_watch``, and the relist is diffed against the
+    consumer-visible prefix set so an entry deleted in the blind
+    window is removed instead of silently retained (the same Replace
+    semantics as the etcd compaction relist).
     """
 
-    def __init__(self, backend: BackendOperations, cache: IPCache):
+    def __init__(self, backend: BackendOperations, cache: IPCache,
+                 restart: bool = False, restart_backoff_s: float = 0.5):
         self.backend = backend
         self.cache = cache
+        self.restart = restart
+        self.restart_backoff_s = restart_backoff_s
+        self.restarts = 0
         self._watcher = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -101,28 +113,58 @@ class IPIdentityWatcher:
         self._thread.start()
 
     def _loop(self) -> None:
-        for event in self._watcher:
-            if self._stop.is_set():
+        known: set = set()  # consumer-visible kvstore-sourced prefixes
+        while True:
+            in_initial = True
+            listed: set = set()
+            for event in self._watcher:
+                if self._stop.is_set():
+                    return
+                if event.typ == "list-done":
+                    if known - listed:
+                        # blind-window deletes: present before the
+                        # stream died, absent from the fresh listing
+                        for prefix in sorted(known - listed):
+                            self.cache.delete(prefix, SOURCE_KVSTORE)
+                            known.discard(prefix)
+                    in_initial = False
+                    self._synced.set()
+                    continue
+                prefix = normalize_prefix(
+                    event.key[len(IP_IDENTITIES_PATH) + 1:])
+                if event.typ in ("create", "modify"):
+                    pair = _unmarshal(event.key, event.value)
+                    if pair is not None:
+                        known.add(pair.prefix)
+                        if in_initial:
+                            listed.add(pair.prefix)
+                        self.cache.upsert(pair.prefix, pair.identity,
+                                          SOURCE_KVSTORE,
+                                          host_ip=pair.host_ip,
+                                          metadata=pair.metadata)
+                elif event.typ == "delete":
+                    known.discard(prefix)
+                    self.cache.delete(prefix, SOURCE_KVSTORE)
+            # stream ended without stop(): dead transport
+            if not self.restart or self._stop.is_set():
                 return
-            if event.typ == "list-done":
-                self._synced.set()
-                continue
-            prefix = event.key[len(IP_IDENTITIES_PATH) + 1:]
-            if event.typ in ("create", "modify"):
-                pair = _unmarshal(event.key, event.value)
-                if pair is not None:
-                    self.cache.upsert(pair.prefix, pair.identity,
-                                      SOURCE_KVSTORE, host_ip=pair.host_ip,
-                                      metadata=pair.metadata)
-            elif event.typ == "delete":
-                self.cache.delete(normalize_prefix(prefix), SOURCE_KVSTORE)
+            if self._stop.wait(self.restart_backoff_s):
+                return
+            try:
+                self._watcher = self.backend.list_and_watch(
+                    IP_IDENTITIES_PATH)
+                self.restarts += 1
+            except Exception:  # noqa: BLE001 — still down; retry
+                # re-enter the backoff with a drained dead watcher
+                self._watcher = iter(())
 
     def wait_synced(self, timeout: float = 5.0) -> bool:
         return self._synced.wait(timeout)
 
     def stop(self) -> None:
         self._stop.set()
-        if self._watcher is not None:
+        if self._watcher is not None and \
+                hasattr(self._watcher, "stop"):
             self._watcher.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)

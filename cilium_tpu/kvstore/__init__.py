@@ -21,14 +21,17 @@ from .backend import (EVENT_CREATE, EVENT_DELETE, EVENT_LIST_DONE,
                       close_client, get_client, register_backend,
                       setup_client, setup_dummy)
 from .etcd import EtcdBackend
+from .journal import WriteJournal
 from .memory import InMemoryBackend
 from .mini_etcd import MiniEtcd
+from .outage import KVStoreDegradedError, OutageGuard
 from .remote import RemoteBackend
 from .server import KVStoreServer
 
 __all__ = [
     "BackendOperations", "EtcdBackend", "Event", "InMemoryBackend",
-    "KVLockError", "KVStoreServer", "MiniEtcd", "RemoteBackend",
+    "KVLockError", "KVStoreDegradedError", "KVStoreServer", "MiniEtcd",
+    "OutageGuard", "RemoteBackend", "WriteJournal",
     "EVENT_CREATE", "EVENT_MODIFY", "EVENT_DELETE", "EVENT_LIST_DONE",
     "setup_client", "setup_dummy", "get_client", "close_client",
     "register_backend",

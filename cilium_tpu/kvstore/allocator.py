@@ -186,6 +186,34 @@ class Allocator:
             return cand, True
         raise AllocatorError(f"allocation of {key!r} kept racing")
 
+    def adopt_cached(self, key: str) -> Optional[int]:
+        """Degraded-mode reuse of a watch-cached binding: take a local
+        ref on the ID the cluster already bound to ``key`` without the
+        lock/lookup kvstore round-trips (the kvstore is down — the
+        cache IS last-known-good truth).  The slave key marking our
+        use is created through the backend, which journals it while
+        degraded and replays it on reconnect.  Returns the adopted ID,
+        or None when the cache has no binding (the caller falls back
+        to a node-local ephemeral identity)."""
+        with self._mu:
+            held = self._local.get(key)
+            if held is not None:
+                id_, ref = held
+                self._local[key] = (id_, ref + 1)
+                return id_
+            existing = self._key_to_id.get(key)
+        if existing is None:
+            return None
+        try:
+            self.backend.create_if_exists(
+                self._master_key(existing), self._slave_key(key),
+                str(existing).encode(), lease=True)
+        except Exception:  # noqa: BLE001 — the local ref is what
+            pass           # matters; the journal/reconcile repairs it
+        with self._mu:
+            self._local[key] = (existing, 1)
+        return existing
+
     def release(self, key: str) -> bool:
         """Drop one local reference; on zero delete our slave key.
         Returns True when the local use count hit zero."""

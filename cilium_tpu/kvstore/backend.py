@@ -95,6 +95,13 @@ class BackendOperations:
 
     name = "abstract"
 
+    # Optional liveness hook: transports with a background lease
+    # keepalive loop (etcd, remote) call ``keepalive_listener(ok)``
+    # after each keepalive attempt when set — the outage detector's
+    # passive signal (kvstore/outage.py) for a control plane that died
+    # with no foreground op in flight.
+    keepalive_listener: "Optional[callable]" = None
+
     # -- plain ops ---------------------------------------------------------
     def get(self, key: str) -> Optional[bytes]:
         raise NotImplementedError

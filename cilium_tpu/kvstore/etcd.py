@@ -173,8 +173,32 @@ class EtcdBackend(BackendOperations):
             try:
                 self._call("/v3/lease/keepalive",
                            {"ID": str(self.lease_id)})
+                ok = True  # transient failures: lease survives to ttl
             except EtcdError:
-                pass  # transient; the lease survives until ttl
+                ok = False
+            listener = self.keepalive_listener
+            if listener is not None:
+                try:
+                    listener(ok)
+                except Exception:  # noqa: BLE001 — observer only
+                    pass
+
+    def _regrant_on_lost_lease(self, fn):
+        """Run a lease-attached mutation; if the session lease expired
+        server-side (an outage outlived the TTL — the server reaped it
+        along with every key it backed), grant a fresh lease and retry
+        once.  ``fn`` must re-read ``self.lease_id`` per attempt.  The
+        outage reconcile (kvstore/outage.py) re-asserts the reaped
+        keys through exactly this path."""
+        try:
+            return fn()
+        except EtcdError as e:
+            if "lease not found" not in str(e).lower():
+                raise
+            out = self._call("/v3/lease/grant",
+                             {"TTL": str(max(1, int(self.lease_ttl)))})
+            self.lease_id = int(out["ID"])
+            return fn()
 
     # ------------------------------------------------------- plain ops
 
@@ -192,10 +216,15 @@ class EtcdBackend(BackendOperations):
         return _b64d(kvs[0]["value"]) if kvs else None
 
     def set(self, key: str, value: bytes, lease: bool = False) -> None:
-        body = {"key": _b64e(key), "value": _b64e(value)}
+        def put():
+            body = {"key": _b64e(key), "value": _b64e(value)}
+            if lease:
+                body["lease"] = str(self.lease_id)
+            self._call("/v3/kv/put", body)
         if lease:
-            body["lease"] = str(self.lease_id)
-        self._call("/v3/kv/put", body)
+            self._regrant_on_lost_lease(put)
+        else:
+            put()
 
     def delete(self, key: str) -> None:
         self._call("/v3/kv/deleterange", {"key": _b64e(key)})
@@ -210,13 +239,17 @@ class EtcdBackend(BackendOperations):
 
     def _txn_put_if(self, compare: Dict, key: str, value: bytes,
                     lease: bool) -> bool:
-        put = {"key": _b64e(key), "value": _b64e(value)}
+        def txn():
+            put = {"key": _b64e(key), "value": _b64e(value)}
+            if lease:
+                put["lease"] = str(self.lease_id)
+            out = self._call("/v3/kv/txn", {
+                "compare": [compare],
+                "success": [{"request_put": put}]})
+            return bool(out.get("succeeded"))
         if lease:
-            put["lease"] = str(self.lease_id)
-        out = self._call("/v3/kv/txn", {
-            "compare": [compare],
-            "success": [{"request_put": put}]})
-        return bool(out.get("succeeded"))
+            return self._regrant_on_lost_lease(txn)
+        return txn()
 
     def create_only(self, key: str, value: bytes,
                     lease: bool = False) -> bool:

@@ -171,16 +171,30 @@ class MiniEtcd:
             self._oldest_rev = rev + 1
             self._cond.notify_all()
 
+    def _reap_expired_locked(self, now: float) -> int:
+        dead = [lid for lid, l in self._leases.items()
+                if l.deadline <= now]
+        for lid in dead:
+            lease = self._leases.pop(lid)
+            for key in sorted(lease.keys):
+                self._delete_locked(key)
+        return len(dead)
+
     def _reaper(self) -> None:
         while not self._stop.wait(self._reap_interval):
-            now = time.monotonic()
             with self._cond:
-                dead = [lid for lid, l in self._leases.items()
-                        if l.deadline <= now]
-                for lid in dead:
-                    lease = self._leases.pop(lid)
-                    for key in sorted(lease.keys):
-                        self._delete_locked(key)
+                self._reap_expired_locked(time.monotonic())
+
+    def expire_leases(self) -> int:
+        """Chaos hook (utils/faultinject.ControlPlaneFaultInjector):
+        expire every live lease NOW and reap its keys — the
+        long-outage scenario where clients' keepalives stopped long
+        enough ago that the server dropped their session state.
+        Returns the number of leases expired."""
+        with self._cond:
+            for lease in self._leases.values():
+                lease.deadline = 0.0
+            return self._reap_expired_locked(time.monotonic())
 
     # ---------------------------------------------------- API handlers
 

@@ -123,7 +123,16 @@ class RemoteBackend(BackendOperations):
         while not self._closed.wait(interval):
             try:
                 self._call("renew_lease")
+                ok = True
             except RemoteError:
+                ok = False
+            listener = self.keepalive_listener
+            if listener is not None:
+                try:
+                    listener(ok)
+                except Exception:  # noqa: BLE001 — observer only
+                    pass
+            if not ok:
                 return
 
     def _call(self, op: str, _timeout: Optional[float] = None,

@@ -15,6 +15,12 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from .backoff import Exponential
+from .metrics import CONTROLLER_RUNS
+
+# a controller at or past this many consecutive failures is surfaced
+# as a top-level degraded signal in status() / `cilium-tpu status`
+# (reference: pkg/controller's failing-controller status rollup)
+FAILING_THRESHOLD = 3
 
 
 @dataclass
@@ -80,6 +86,8 @@ class Controller:
                     self.status.consecutive_failures = 0
                     self.status.last_error = ""
                     self.status.last_success = time.time()
+                CONTROLLER_RUNS.inc(labels={"name": self.name,
+                                            "status": "success"})
                 backoff.reset()
                 wait = params.run_interval if params.run_interval > 0 else None
             except Exception as exc:  # reconcile errors must not kill loop
@@ -90,6 +98,8 @@ class Controller:
                         "".join(traceback.format_exception_only(
                             type(exc), exc)).strip()
                     self.status.last_failure = time.time()
+                CONTROLLER_RUNS.inc(labels={"name": self.name,
+                                            "status": "failure"})
                 wait = backoff.next_duration()
             if wait is None:
                 self._wake.wait()
@@ -150,3 +160,19 @@ class ControllerManager:
             "consecutive-failure-count": c.status.consecutive_failures,
             "last-failure-msg": c.status.last_error,
         } for name, c in sorted(ctrls.items())]
+
+    def failing(self, threshold: int = FAILING_THRESHOLD) -> List[Dict]:
+        """Controllers at/past ``threshold`` consecutive failures —
+        the top-level degraded signal for status() (a wedged reconcile
+        loop must not stay buried in the controller list)."""
+        with self._lock:
+            ctrls = dict(self._controllers)
+        out = []
+        for name, c in sorted(ctrls.items()):
+            with c._lock:
+                n = c.status.consecutive_failures
+                err = c.status.last_error
+            if n >= threshold:
+                out.append({"name": name, "consecutive-failures": n,
+                            "last-error": err})
+        return out

@@ -33,7 +33,7 @@ import socket
 import threading
 import time
 from collections import deque
-from typing import Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 class DeviceLaneFault(RuntimeError):
@@ -356,3 +356,116 @@ class FaultProxy:
         with self._mu:
             if pair in self._pairs:
                 self._pairs.remove(pair)
+
+
+class ControlPlaneFaultInjector:
+    """The CONTROL-plane chaos hand (the etcd/apiserver twin of
+    ``DeviceFaultInjector``): drives one ``FaultProxy`` per
+    control-plane peer — the kvstore (etcd) and the apiserver — so a
+    chaos test can blackhole, partition, or flap exactly the planes the
+    outage guard (kvstore/outage.py) and the reflector breaker
+    (k8s/client.py) must absorb, plus expire server-side leases to
+    force the lease-grace repair path.
+
+    - ``blackhole(plane)``: connections accepted but forwarded nowhere
+      (the dark-partition half: in-flight requests hang to their
+      deadlines); live streams are reset so watch readers see the cut.
+    - ``partition(plane)``: connections actively refused (fast-fail
+      RST partition) + live streams reset.
+    - ``heal(plane)``: forward again.
+    - ``flap(plane, cycles, period)``: partition/heal cycles on a
+      background thread (breaker-cadence chaos).
+    - ``expire_leases()``: invoke the server-side lease expirer (e.g.
+      ``MiniEtcd.expire_leases``) — the long-outage scenario where the
+      server reaped every lease-backed key.
+    """
+
+    PLANES = ("etcd", "apiserver")
+
+    def __init__(self, etcd: Optional[FaultProxy] = None,
+                 apiserver: Optional[FaultProxy] = None,
+                 lease_expirer: Optional[Callable[[], int]] = None):
+        self._proxies: Dict[str, FaultProxy] = {}
+        if etcd is not None:
+            self._proxies["etcd"] = etcd
+        if apiserver is not None:
+            self._proxies["apiserver"] = apiserver
+        self._lease_expirer = lease_expirer
+        self._mu = threading.Lock()
+        self._flapper: Optional[threading.Thread] = None
+        self._flap_stop = threading.Event()
+        self.faults: List[Tuple[str, str]] = []  # (plane, action) log
+
+    def proxy(self, plane: str) -> FaultProxy:
+        return self._proxies[plane]
+
+    def _each(self, plane: Optional[str]):
+        if plane is None:
+            return list(self._proxies.items())
+        return [(plane, self._proxies[plane])]
+
+    def _log(self, plane: str, action: str) -> None:
+        with self._mu:
+            self.faults.append((plane, action))
+
+    # ------------------------------------------------------- faults
+
+    def blackhole(self, plane: str = "etcd") -> None:
+        for name, proxy in self._each(plane):
+            proxy.pause()
+            proxy.reset_all()
+            self._log(name, "blackhole")
+
+    def partition(self, plane: str = "etcd") -> None:
+        for name, proxy in self._each(plane):
+            proxy.refuse_connections = True
+            proxy.reset_all()
+            self._log(name, "partition")
+
+    def heal(self, plane: Optional[str] = None) -> None:
+        for name, proxy in self._each(plane):
+            proxy.refuse_connections = False
+            proxy.resume()
+            self._log(name, "heal")
+
+    def flap(self, plane: str = "etcd", cycles: int = 3,
+             period_s: float = 0.2) -> threading.Thread:
+        """Partition/heal ``cycles`` times, ``period_s`` per half
+        cycle, on a background thread (returned for joining)."""
+        self._flap_stop.clear()
+
+        def run():
+            for _ in range(cycles):
+                if self._flap_stop.is_set():
+                    break
+                self.partition(plane)
+                if self._flap_stop.wait(period_s):
+                    break
+                self.heal(plane)
+                if self._flap_stop.wait(period_s):
+                    break
+            self.heal(plane)
+
+        self._flapper = threading.Thread(target=run, daemon=True,
+                                         name="cp-flapper")
+        self._flapper.start()
+        return self._flapper
+
+    def expire_leases(self) -> int:
+        if self._lease_expirer is None:
+            raise RuntimeError("no lease expirer wired")
+        self._log("etcd", "expire-leases")
+        return int(self._lease_expirer())
+
+    # ---------------------------------------------------- lifecycle
+
+    def stats(self) -> Dict:
+        with self._mu:
+            return {"faults": list(self.faults),
+                    "planes": sorted(self._proxies)}
+
+    def close(self) -> None:
+        self._flap_stop.set()
+        if self._flapper is not None:
+            self._flapper.join(timeout=5)
+        self.heal()

@@ -310,6 +310,31 @@ IDENTITY_COUNT = registry.gauge(
 KVSTORE_OPERATIONS = registry.counter(
     "kvstore_operations_total", "kvstore operations by kind")
 
+# Control-plane survivability series (kvstore/outage.py): the outage
+# detector's mode/staleness view, the degraded-mode write journal, and
+# the reconnect reconcile accounting — the control-plane twin of the
+# dataplane_mode / fail-static series above.
+KVSTORE_MODE = registry.gauge(
+    "kvstore_mode",
+    "kvstore client mode (0 ok / 1 degraded / 2 reconciling)")
+KVSTORE_STALENESS = registry.gauge(
+    "kvstore_staleness_seconds",
+    "Seconds since the last successful kvstore operation (0 while the "
+    "last operation succeeded)")
+KVSTORE_JOURNAL_DEPTH = registry.gauge(
+    "kvstore_journal_depth",
+    "Mutations queued in the degraded-mode write journal awaiting "
+    "reconnect replay")
+KVSTORE_RECONCILE = registry.counter(
+    "kvstore_reconcile_total",
+    "Reconnect reconciles (journal replay + local-key repair) by "
+    "result")
+# Controller health (utils/controller.py): per-run outcome accounting
+# behind the top-level controller-health degraded signal in status().
+CONTROLLER_RUNS = registry.counter(
+    "controller_runs_total",
+    "Controller reconcile runs by controller name and outcome")
+
 # Hubble flow-observability series (pkg/hubble/metrics analog): flow
 # throughput, drops by reason x identity pair, L7 response-code
 # distributions, and relay federation health.

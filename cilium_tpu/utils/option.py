@@ -284,6 +284,30 @@ class DaemonConfig:
     # fail-static while the rest keep serving on device).  0/1 = the
     # single-engine dataplane.  Device count must divide evenly.
     dataplane_shards: int = 0
+    # control-plane outage survivability (kvstore/outage.py): opt-in.
+    # When enabled, sustained kvstore failure (breaker-open /
+    # lease-keepalive loss) flips kvstore_mode to degraded: consumers
+    # pin last-known-good state with a tracked staleness age, kvstore
+    # mutations are journaled for reconnect replay, and identity
+    # allocation falls back to node-local ephemeral IDs promoted to
+    # cluster scope on reconnect.  Disabled = behavior-identical to the
+    # unwrapped backend (status-path staleness bookkeeping only).
+    enable_kvstore_survival: bool = False
+    # consecutive op/probe failures before the outage breaker opens
+    kvstore_failure_threshold: int = 3
+    # the kvstore-outage controller's tick cadence: idle-probe period
+    # while ok, half-open probe cadence floor while degraded
+    kvstore_probe_interval_s: float = 0.5
+    # lease grace window: an outage shorter than this is expected to
+    # leave our lease-backed keys intact server-side; the reconnect
+    # reconcile re-asserts them either way and flags exceeded-grace
+    kvstore_grace_s: float = 60.0
+    # write-journal depth bound (per-key-coalesced entries; overflow
+    # evicts oldest with accounting)
+    kvstore_journal_max: int = 8192
+    # reconnect reconcile rate limit (journal replay + local-key
+    # repair ops per second; 0 = unthrottled)
+    kvstore_reconcile_ops_per_s: float = 2000.0
     kvstore: str = "memory"
     kvstore_opts: Dict[str, str] = field(default_factory=dict)
     # runtime-mutable option map shared by new endpoints

@@ -57,7 +57,7 @@ def test_smoke_final_line_parses_and_fits(tmp_path):
     suite = extra["suite"]
     for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
                  "capacity", "incremental", "latency-tier",
-                 "overload", "mesh-shard"):
+                 "overload", "mesh-shard", "control-churn"):
         assert name in suite, f"{name} missing from compact suite"
         assert "value" in suite[name]
         assert "vs_baseline" in suite[name]
@@ -120,6 +120,20 @@ def test_smoke_writes_full_result_file(tmp_path):
                 "fail_static_records",
                 "healthy_shards_stayed_closed"):
         assert key in deg, key
+    # the control-churn schema is pinned: healthy/outage/reconnect
+    # legs with journal depth, reconcile time, and the
+    # regenerations-avoided-vs-naive-full-resync accounting
+    cc = res["extra"]["suite_configs"]["control-churn"]
+    assert cc["unit"] == "ops/s"
+    legs = cc["extra"]["legs"]
+    assert "churn_ops_per_sec" in legs["healthy"]
+    for key in ("churn_ops_per_sec", "journal_depth",
+                "local_identities", "staleness_seconds"):
+        assert key in legs["outage"], key
+    for key in ("reconcile_seconds", "journal_replayed", "promoted",
+                "regenerations", "naive_full_resync_regens",
+                "regenerations_avoided"):
+        assert key in legs["reconnect"], key
     # and the committed on-accel artifact is embedded here, not inline
     assert "last_on_accel" in res["extra"]
     assert res["extra"]["last_on_accel"]["result"]["value"]

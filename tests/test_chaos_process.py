@@ -94,6 +94,157 @@ def _wait_verdict(vc, slot, dport, want_allow, timeout=60, base=51000):
     return False
 
 
+def test_kill9_restart_under_policy_churn(tmp_path):
+    """Restart-under-churn: SIGKILL the agent while BOTH verdict
+    traffic and policy churn (rule add/delete cycles) are in flight,
+    restart on the same state dir, and assert:
+
+    - restore_endpoints keeps the established flow forwarding (CT
+      checkpoint + realized-state restore) with zero wrong-allows at
+      any point;
+    - the post-restore drift audit is green: the restored device
+      tables replay bit-exact against the host policy oracles
+      (POST /debug/drift-audit) both before and after the
+      orchestrator re-imports policy.
+    """
+    state = tmp_path / "state"
+    proc, info = _spawn(state)
+    proc2 = None
+    stop = threading.Event()
+    wrong_allows = []
+    churn_cycles = [0]
+    ports = {"verdict": info["verdict_port"]}
+    CHURN_RULE = [{
+        "endpointSelector": {"matchLabels": {"id": "db"}},
+        "ingress": [{"toPorts": [{"ports": [
+            {"port": "6100", "protocol": "TCP"}]}]}],
+        "labels": ["k8s:policy=churn"],
+    }]
+    try:
+        c = Client(f"http://127.0.0.1:{info['api_port']}")
+        c.put("/endpoint/1", {"ipv4": WEB_IP, "labels": ["k8s:id=web"]})
+        c.put("/endpoint/2", {"ipv4": DB_IP, "labels": ["k8s:id=db"]})
+        c.request("PUT", "/policy", RULES)
+        slot = c.get("/endpoint/2")["table-slot"]
+
+        vc = VerdictClient("127.0.0.1", ports["verdict"], timeout=120)
+        assert _wait_verdict(vc, slot, 5432, True), "policy never applied"
+        # the long-lived flow: SYN establishes CT, ACKs ride it
+        v, _ = vc.classify(_recs(slot, 46001, 5432, SYN))
+        assert int(v[0]) >= 0
+        v, _ = vc.classify(_recs(slot, 46001, 5432, ACK))
+        assert int(v[0]) >= 0
+        established_at = time.time()
+
+        def traffic():
+            client = None
+            k = 0
+            while not stop.is_set():
+                try:
+                    if client is None:
+                        client = VerdictClient(
+                            "127.0.0.1", ports["verdict"], timeout=10)
+                    v, _ = client.classify(
+                        _recs(slot, 48000 + (k % 8000), 9999, SYN))
+                    if int(v[0]) >= 0:
+                        wrong_allows.append(("fresh-denied-allowed", k))
+                    v, _ = client.classify(
+                        _recs(slot, 46001, 5432, ACK))
+                except (VerdictServiceError, OSError,
+                        ConnectionError, socket.timeout):
+                    if client is not None:
+                        try:
+                            client.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        client = None
+                    stop.wait(0.05)
+                k += 1
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def policy_churn():
+            """Rule add/delete cycles racing the kill window (REST
+            failures during the dead window are the expected shape)."""
+            cc = Client(f"http://127.0.0.1:{info['api_port']}")
+            while not stop.is_set():
+                try:
+                    cc.request("PUT", "/policy", CHURN_RULE)
+                    stop.wait(0.05)
+                    cc.request("DELETE",
+                               "/policy?labels=k8s:policy%3Dchurn")
+                    churn_cycles[0] += 1
+                except (Exception, SystemExit):  # noqa: BLE001 — the
+                    # dead window (APIError subclasses SystemExit)
+                    stop.wait(0.1)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        tp = threading.Thread(target=policy_churn, daemon=True)
+        tp.start()
+
+        # churn + traffic against the healthy agent, and a CT
+        # checkpoint that has captured the established flow
+        deadline = time.time() + 20
+        ct_path = os.path.join(str(state), "ct_state.npz")
+        while time.time() < deadline and not (
+                churn_cycles[0] >= 2 and os.path.exists(ct_path) and
+                os.path.getmtime(ct_path) > established_at):
+            time.sleep(0.05)
+        assert churn_cycles[0] >= 2, "policy churn never ran"
+        assert os.path.exists(ct_path), "no periodic CT checkpoint"
+
+        # ---- chaos: SIGKILL mid-traffic, mid-churn ----
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        time.sleep(0.3)
+
+        # ---- supervisor restart on the same state dir ----
+        proc2, info2 = _spawn(state)
+        assert info2["restored"] == 2
+        ports["verdict"] = info2["verdict_port"]
+        c2 = Client(f"http://127.0.0.1:{info2['api_port']}")
+        vc2 = VerdictClient("127.0.0.1", ports["verdict"], timeout=120)
+
+        # established flow survived the kill (restore_endpoints +
+        # CT checkpoint), before any policy re-import
+        v, _ = vc2.classify(_recs(slot, 46001, 5432, ACK))
+        assert int(v[0]) >= 0, "established flow lost by kill -9"
+        v, _ = vc2.classify(_recs(slot, 50002, 9999, SYN))
+        assert int(v[0]) < 0, "restore admitted a denied flow"
+
+        # the post-restore drift audit is green: the restored realized
+        # state and the device tables tell one story
+        rep = c2.request("POST", "/debug/drift-audit")
+        assert rep["status"] in ("ok", "idle"), rep
+        assert rep["checked"] > 0 or rep["status"] == "idle"
+
+        # orchestrator re-imports; the system converges and the audit
+        # stays green under the re-imported policy
+        c2.request("PUT", "/policy", RULES)
+        assert _wait_verdict(vc2, slot, 5432, True, base=52000)
+        assert _wait_verdict(vc2, slot, 9999, False, base=53000)
+        rep = c2.request("POST", "/debug/drift-audit")
+        assert rep["status"] in ("ok", "idle"), rep
+
+        stop.set()
+        t.join(timeout=20)
+        tp.join(timeout=20)
+        assert not t.is_alive(), "traffic thread wedged"
+        assert not wrong_allows, wrong_allows[:5]
+        vc.close()
+        vc2.close()
+    finally:
+        stop.set()
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
 def test_kill9_under_traffic_restores_without_wrong_allows(tmp_path):
     state = tmp_path / "state"
     proc, info = _spawn(state)

@@ -1,0 +1,614 @@
+"""Control-plane outage survivability under injected chaos.
+
+The control-plane twin of tests/test_dataplane_chaos.py: the kvstore
+(etcd) and the apiserver are driven through blackholes, partitions,
+flaps, and lease expiry by ``ControlPlaneFaultInjector``, and the
+outage layer (kvstore/outage.py + kvstore/journal.py + the identity
+fallback in kvstore/identity_allocator.py) must absorb them:
+
+- sustained kvstore failure flips ``kvstore_mode`` to degraded;
+  identities/ipcache/nodes pin last-known-good with a growing
+  staleness age; the dataplane keeps serving bit-exact verdicts;
+- an endpoint created during the outage gets a node-local ephemeral
+  identity (local scope, bit 24) and correct verdicts;
+- mutations journal (per-key-coalesced, bounded) and replay on
+  reconnect, followed by the relist-and-diff repair of locally owned
+  lease-backed keys;
+- local identities are promoted to cluster scope on reconnect via the
+  incremental delta-apply path — regeneration bounded by the
+  actually-diverged endpoint set, established flows keep forwarding;
+- the disabled path is behavior-identical to an unwrapped backend.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.identity import (LOCAL_SCOPE_IDENTITY_BASE,
+                                 is_local_scope_identity)
+from cilium_tpu.kvstore.etcd import EtcdBackend
+from cilium_tpu.kvstore.identity_allocator import (
+    DistributedIdentityAllocator, FallbackIdentityAllocator)
+from cilium_tpu.kvstore.journal import WriteJournal
+from cilium_tpu.kvstore.memory import InMemoryBackend
+from cilium_tpu.kvstore.mini_etcd import MiniEtcd
+from cilium_tpu.kvstore.outage import KVStoreDegradedError, OutageGuard
+from cilium_tpu.labels import Labels, parse_label
+from cilium_tpu.policy.jsonio import rules_from_json
+from cilium_tpu.policy.mapstate import PolicyMapState
+from cilium_tpu.utils.faultinject import (ControlPlaneFaultInjector,
+                                          FaultProxy)
+from cilium_tpu.utils.metrics import (KVSTORE_RECONCILE,
+                                      POLICY_REGENERATION_COUNT)
+from cilium_tpu.utils.option import DaemonConfig
+
+WEB_IP, DB_IP, TMP_IP = "10.200.0.10", "10.200.0.11", "10.200.0.12"
+
+
+def _wait_for(cond, timeout=30.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _labels(*items):
+    return Labels.from_labels(parse_label(i) for i in items)
+
+
+# ------------------------------------------------------- unit: journal
+
+def test_write_journal_coalesces_and_bounds():
+    j = WriteJournal(max_entries=3)
+    j.record("set", "a", b"1")
+    j.record("set", "a", b"2")
+    assert j.depth() == 1 and j.stats()["coalesced"] == 1
+    j.record("delete", "a")
+    # the delete replaced the pending set — replay ends with a delete
+    assert j.depth() == 1 and j.snapshot()[0].op == "delete"
+    # delete_prefix subsumes pending mutations under the prefix
+    j.record("set", "p/x", b"1")
+    j.record("set", "p/y", b"2")
+    j.record("delete_prefix", "p/")
+    assert j.depth() == 2
+    ops = [e.op for e in j.snapshot()]
+    assert ops == ["delete", "delete_prefix"]
+    # bound: oldest evicted with accounting
+    j.record("set", "b", b"1")
+    j.record("set", "c", b"1")
+    assert j.depth() == 3
+    assert j.stats()["dropped"] == 1
+    # replay order is by sequence
+    seqs = [e.seq for e in j.snapshot()]
+    assert seqs == sorted(seqs)
+    # a live write supersedes the pending entry
+    j.discard_key("c")
+    assert all(e.key != "c" for e in j.snapshot())
+
+
+# --------------------------------------------------- unit: outage guard
+
+class _FlakyBackend(InMemoryBackend):
+    """In-memory backend with a failure switch."""
+
+    def __init__(self):
+        super().__init__()
+        self.fail = False
+
+    def _gate(self):
+        if self.fail:
+            raise OSError("injected kvstore failure")
+
+    def get(self, key):
+        self._gate()
+        return super().get(key)
+
+    def list_prefix(self, prefix):
+        self._gate()
+        return super().list_prefix(prefix)
+
+    def set(self, key, value, lease=False):
+        self._gate()
+        return super().set(key, value, lease)
+
+    def delete(self, key):
+        self._gate()
+        return super().delete(key)
+
+    def lock_path(self, path, timeout=30.0):
+        self._gate()
+        return super().lock_path(path, timeout)
+
+
+def test_outage_guard_degrades_journals_and_reconciles():
+    inner = _FlakyBackend()
+    guard = OutageGuard(inner, degrade=True, failure_threshold=2,
+                        probe_interval=0.05)
+    guard.track_prefix("t/")
+    guard.set("t/pre", b"v0", lease=True)
+    assert guard.mode == "ok" and guard.staleness() == 0.0
+
+    inner.fail = True
+    # mutations during the failing window journal instead of raising
+    guard.set("t/k", b"v1", lease=True)
+    guard.set("t/k", b"v2", lease=True)   # coalesces
+    assert guard.mode == "degraded"
+    assert guard.journal.depth() == 1
+    # reads and locks fail FAST while degraded (no per-op timeouts)
+    t0 = time.monotonic()
+    with pytest.raises((KVStoreDegradedError, OSError)):
+        guard.get("t/pre")
+    assert time.monotonic() - t0 < 0.5
+    with pytest.raises((KVStoreDegradedError, OSError)):
+        guard.lock_path("t/lock")
+    # a non-lease CAS create must not be faked
+    with pytest.raises((KVStoreDegradedError, OSError)):
+        guard.create_only("t/master", b"x")
+    assert guard.staleness() > 0.0
+    rep = guard.report()
+    assert rep["mode"] == "degraded" and rep["journal-depth"] == 1
+
+    # the server "reaps" a lease-backed key behind our back (lease
+    # expiry during the outage) — the reconcile must re-assert it
+    InMemoryBackend.delete(inner, "t/pre")
+
+    inner.fail = False
+    reconciles = KVSTORE_RECONCILE.value(labels={"result": "ok"})
+    time.sleep(0.1)
+    event = guard.tick()
+    assert event.get("reconciled") is True
+    assert guard.mode == "ok"
+    assert inner.get("t/k") == b"v2"       # journal replayed
+    assert inner.get("t/pre") == b"v0"     # lease-grace repair
+    report = event["report"]
+    assert report["replayed"] == 1 and report["repaired"] == 1
+    assert KVSTORE_RECONCILE.value(labels={"result": "ok"}) > reconciles
+    assert guard.journal.depth() == 0
+
+
+def test_outage_guard_disabled_is_passthrough():
+    """degrade=False: bookkeeping only — every op delegates with
+    identical semantics and exceptions (the pre-change behavior)."""
+    inner = _FlakyBackend()
+    guard = OutageGuard(inner, degrade=False)
+    guard.set("k", b"v")
+    assert guard.get("k") == b"v"
+    inner.fail = True
+    with pytest.raises(OSError):
+        guard.set("k", b"v2")      # raises, never journals
+    with pytest.raises(OSError):
+        guard.get("k")
+    assert guard.journal.depth() == 0
+    assert guard.mode == "ok"      # mode never flips when disabled
+    # ... but the status bookkeeping still tracks the failure
+    assert guard.staleness() > 0.0
+    assert guard.report()["consecutive-failures"] >= 2
+    inner.fail = False
+    assert guard.get("k") == b"v"
+    assert guard.staleness() == 0.0
+    assert guard.tick() == {}      # tick is inert when disabled
+
+
+# ------------------------------------- unit: identity fallback/adoption
+
+def test_fallback_allocator_local_scope_and_adoption():
+    backend = InMemoryBackend()
+    guard = OutageGuard(backend, degrade=True, failure_threshold=1,
+                        probe_interval=0.05)
+    dist = DistributedIdentityAllocator(guard, node="n1")
+    fb = FallbackIdentityAllocator(dist, guard=guard)
+    try:
+        # healthy: plain distributed allocation
+        web, is_new = fb.allocate(_labels("k8s:id=web"))
+        assert is_new and not is_local_scope_identity(web.id)
+
+        # force degraded
+        guard._note_failure()
+        assert guard.mode == "degraded"
+
+        # labels the cluster already bound: ADOPT the cached ID
+        again, _ = fb.allocate(_labels("k8s:id=web"))
+        assert again.id == web.id
+        # release the extra ref (delete journals while degraded)
+        fb.release(again)
+
+        # genuinely new labels: node-local ephemeral identity
+        tmp, is_new = fb.allocate(_labels("k8s:id=tmp"))
+        assert is_new and is_local_scope_identity(tmp.id)
+        assert tmp.id >= LOCAL_SCOPE_IDENTITY_BASE
+        assert fb.local_count() == 1
+        # same labels -> same local id, refcounted
+        tmp2, is_new = fb.allocate(_labels("k8s:id=tmp"))
+        assert not is_new and tmp2.id == tmp.id
+        assert fb.lookup_by_id(tmp.id) == tmp
+        assert fb.lookup_by_labels(_labels("k8s:id=tmp")).id == tmp.id
+        assert any(i.id == tmp.id for i in fb.snapshot_identities())
+        assert fb.release(tmp2) is False
+        assert fb.release(tmp) is True
+        assert fb.local_count() == 0
+    finally:
+        fb.close()
+
+
+# ----------------------------------------- live-daemon outage journey
+
+RULES_JSON = json.dumps([{
+    "endpointSelector": {"matchLabels": {"id": "db"}},
+    "ingress": [
+        {"fromEndpoints": [{"matchLabels": {"id": "web"}}],
+         "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+        {"fromEndpoints": [{"matchLabels": {"id": "tmp"}}],
+         "toPorts": [{"ports": [{"port": "7000", "protocol": "TCP"}]}]},
+    ],
+    "labels": ["k8s:policy=cp-chaos"],
+}])
+
+
+@pytest.fixture()
+def etcd_server():
+    srv = MiniEtcd(reap_interval=0.1).start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture()
+def injector(etcd_server):
+    proxy = FaultProxy("127.0.0.1", etcd_server.port).start()
+    inj = ControlPlaneFaultInjector(etcd=proxy,
+                                    lease_expirer=etcd_server
+                                    .expire_leases)
+    yield inj
+    inj.close()
+    proxy.close()
+
+
+def _ip_u32(dotted):
+    a, b, c, d = (int(x) for x in dotted.split("."))
+    return (a << 24) | (b << 16) | (c << 8) | d
+
+
+def _recs(slot, n, dport, saddr, sport0, flags=0x02):
+    return {"endpoint": np.full(n, slot, np.int32),
+            "saddr": np.full(n, _ip_u32(saddr),
+                             np.uint32).view(np.int32),
+            "daddr": np.full(n, _ip_u32(DB_IP),
+                             np.uint32).view(np.int32),
+            "sport": (sport0 + np.arange(n)).astype(np.int32),
+            "dport": np.full(n, dport, np.int32),
+            "proto": np.full(n, 6, np.int32),
+            "direction": np.zeros(n, np.int32),   # ingress to db
+            "tcp_flags": np.full(n, flags, np.int32),
+            "is_fragment": np.zeros(n, np.int32),
+            "length": np.full(n, 256, np.int32)}
+
+
+def _verdicts(disp, recs):
+    t = disp.submit_records(recs, len(recs["sport"]))
+    v, i = t.result(timeout=120)
+    assert t.error is None
+    return np.asarray(v), np.asarray(i)
+
+
+def test_daemon_outage_journey(etcd_server, injector):
+    """The acceptance journey: blackhole etcd mid-run -> degraded with
+    growing staleness, dataplane bit-exact, outage endpoint on a
+    local-scope identity with correct verdicts; reconnect -> journal
+    replay + reconcile converge, drift audit green, local identities
+    promoted without dropping established flows, regeneration bounded
+    by the actually-diverged endpoint set."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    kv = EtcdBackend(host="127.0.0.1", port=injector.proxy("etcd").port,
+                     lease_ttl=30.0, timeout=1.0)
+    cfg = DaemonConfig(state_dir="", drift_audit_interval_s=0,
+                       ct_checkpoint_interval_s=0,
+                       enable_kvstore_survival=True,
+                       kvstore_probe_interval_s=0.1,
+                       kvstore_failure_threshold=2)
+    d = Daemon(config=cfg, kvstore_backend=kv, node_name="n1")
+    observer = EtcdBackend(port=etcd_server.port, lease_ttl=30.0)
+    try:
+        d.endpoint_create(1, ipv4=WEB_IP, labels=["k8s:id=web"])
+        d.endpoint_create(2, ipv4=DB_IP, labels=["k8s:id=db"])
+        # bystanders: endpoints the promotion must NOT regenerate
+        for k in range(4):
+            d.endpoint_create(10 + k, ipv4=f"10.200.1.{10 + k}",
+                              labels=[f"k8s:id=bystander{k}"])
+        rev = d.policy_add(rules_from_json(RULES_JSON))
+        assert d.wait_for_policy_revision(rev, timeout=60)
+        st = d.status()["kvstore"]
+        assert st["mode"] == "ok" and st["backend"] == "EtcdBackend"
+
+        disp = d.datapath.serving()
+        slot = d.endpoints.lookup(2).table_slot
+        # establish a long-lived flow web -> db:5432 (SYN then ACK)
+        v, _ = _verdicts(disp, _recs(slot, 4, 5432, WEB_IP, 40000))
+        assert (v == 0).all()
+        v, _ = _verdicts(disp, _recs(slot, 4, 5432, WEB_IP, 40000,
+                                     flags=0x10))
+        assert (v == 0).all()
+
+        # ---- blackhole etcd mid-run ----
+        injector.blackhole("etcd")
+        _wait_for(lambda: d.status()["kvstore"]["mode"] == "degraded",
+                  msg="kvstore degraded")
+        s1 = d.status()["kvstore"]["staleness-seconds"]
+        time.sleep(0.4)
+        st = d.status()["kvstore"]
+        assert st["staleness-seconds"] > s1, "staleness must grow"
+        assert "DEGRADED" in st["state"]
+        assert st["breaker"] != "closed"
+
+        # dataplane keeps serving bit-exact: drift audit replays the
+        # live compiled tables against the host oracles
+        rep = d.run_drift_audit()
+        assert rep["status"] in ("ok", "idle")
+        # established flow still forwards, denied still denied
+        v, _ = _verdicts(disp, _recs(slot, 4, 5432, WEB_IP, 40000,
+                                     flags=0x10))
+        assert (v == 0).all()
+        v, _ = _verdicts(disp, _recs(slot, 4, 9999, WEB_IP, 41000))
+        assert (v < 0).all()
+
+        # ---- endpoint created DURING the outage ----
+        t0 = time.monotonic()
+        ep3 = d.endpoint_create(3, ipv4=TMP_IP, labels=["k8s:id=tmp"])
+        create_s = time.monotonic() - t0
+        assert create_s < 5.0, \
+            f"degraded create took {create_s:.1f}s (not failing fast)"
+        local_id = ep3.security_identity
+        assert is_local_scope_identity(local_id)
+        assert d.wait_for_policy_revision(rev, timeout=60)
+        st = d.status()["kvstore"]
+        assert st["local-identities"] == 1
+        assert st["journal-depth"] >= 1   # the ipcache upsert journaled
+
+        # correct verdicts for the outage endpoint: tmp -> db:7000
+        # allowed, anything else denied
+        v, ident = _verdicts(disp, _recs(slot, 4, 7000, TMP_IP, 42000))
+        assert (v == 0).all()
+        assert (ident == local_id).all()
+        v, _ = _verdicts(disp, _recs(slot, 4, 9999, TMP_IP, 43000))
+        assert (v < 0).all()
+        rep = d.run_drift_audit()
+        assert rep["status"] in ("ok", "idle")
+
+        # ---- reconnect ----
+        regen_before = POLICY_REGENERATION_COUNT.total()
+        injector.heal()
+        _wait_for(lambda: d.status()["kvstore"]["mode"] == "ok",
+                  msg="kvstore mode back to ok")
+        _wait_for(lambda:
+                  d.status()["kvstore"]["local-identities"] == 0,
+                  msg="local identities promoted")
+        ep3 = d.endpoints.lookup(3)
+        new_id = ep3.security_identity
+        assert not is_local_scope_identity(new_id)
+
+        # converged: db's realized map now names the promoted identity
+        def _db_promoted():
+            state = PolicyMapState(d.endpoints.lookup(2).realized)
+            keys = [k for k in state.keys() if k.dest_port == 7000]
+            return keys and all(k.identity == new_id for k in keys)
+        _wait_for(lambda: _db_promoted() and
+                  d.wait_for_quiesce(0.1),
+                  msg="referencing endpoint re-keyed")
+
+        # regeneration bounded by the actually-diverged set (ep3 +
+        # db), never the bystanders (a full-resync would be 7 builds)
+        regens = POLICY_REGENERATION_COUNT.total() - regen_before
+        assert regens <= 3, \
+            f"{regens} regenerations — promotion fanned out too wide"
+
+        # reconcile replayed the journal; the store now carries the
+        # PROMOTED identity for the outage endpoint's IP
+        st = d.status()["kvstore"]
+        assert st["last-reconcile"] is not None
+        assert st["last-reconcile"]["replayed"] >= 1
+
+        def _published():
+            raw = observer.get(f"cilium/state/ip/v1/default/{TMP_IP}/32")
+            return raw is not None and \
+                json.loads(raw.decode())["ID"] == new_id
+        _wait_for(_published, msg="promoted identity published")
+
+        # established flow survived the whole journey (CT untouched)
+        v, _ = _verdicts(disp, _recs(slot, 4, 5432, WEB_IP, 40000,
+                                     flags=0x10))
+        assert (v == 0).all()
+        # post-promotion verdicts stay correct and drift-free
+        v, ident = _verdicts(disp, _recs(slot, 4, 7000, TMP_IP, 44000))
+        assert (v == 0).all() and (ident == new_id).all()
+        rep = d.run_drift_audit()
+        assert rep["status"] in ("ok", "idle")
+    finally:
+        d.shutdown()
+        kv.close()
+        observer.close()
+
+
+def test_daemon_flap_and_lease_expiry_repair(etcd_server, injector):
+    """Flap etcd through the injector, then expire every server-side
+    lease mid-outage: the reconcile's lease-grace repair re-asserts the
+    reaped lease-backed keys (node registration, ipcache entries)."""
+    kv = EtcdBackend(host="127.0.0.1", port=injector.proxy("etcd").port,
+                     lease_ttl=30.0, timeout=1.0)
+    cfg = DaemonConfig(state_dir="", drift_audit_interval_s=0,
+                       ct_checkpoint_interval_s=0,
+                       enable_kvstore_survival=True,
+                       kvstore_probe_interval_s=0.1,
+                       kvstore_failure_threshold=2,
+                       enable_hubble=False)
+    d = Daemon(config=cfg, kvstore_backend=kv, node_name="n1")
+    observer = EtcdBackend(port=etcd_server.port, lease_ttl=30.0)
+    try:
+        d.register_node("10.0.0.1", "10.200.0.0/16")
+        d.endpoint_create(1, ipv4=WEB_IP, labels=["k8s:id=web"])
+        node_key = "cilium/state/nodes/v1/default/n1"
+        ip_key = f"cilium/state/ip/v1/default/{WEB_IP}/32"
+        _wait_for(lambda: observer.get(node_key) is not None,
+                  msg="node registered")
+        assert observer.get(ip_key) is not None
+
+        # flap: partition/heal cycles — the guard must end closed
+        injector.flap("etcd", cycles=2, period_s=0.3).join(timeout=10)
+        _wait_for(lambda: d.status()["kvstore"]["mode"] == "ok",
+                  msg="guard recovered from flap")
+
+        # long outage: blackhole AND expire every lease server-side
+        injector.blackhole("etcd")
+        _wait_for(lambda: d.status()["kvstore"]["mode"] == "degraded",
+                  msg="degraded after blackhole")
+        assert injector.expire_leases() >= 1
+        assert observer.get(node_key) is None, "lease reap expected"
+        assert observer.get(ip_key) is None
+
+        injector.heal()
+        _wait_for(lambda: d.status()["kvstore"]["mode"] == "ok",
+                  msg="reconciled after lease expiry")
+        # the repair re-asserted our lease-backed keys (with a fresh
+        # lease — the old one is gone server-side)
+        _wait_for(lambda: observer.get(node_key) is not None,
+                  msg="node registration repaired")
+        _wait_for(lambda: observer.get(ip_key) is not None,
+                  msg="ipcache entry repaired")
+        rec = d.status()["kvstore"]["last-reconcile"]
+        assert rec["repaired"] >= 1
+        assert ("expire-leases" in
+                [a for _p, a in injector.stats()["faults"]])
+    finally:
+        d.shutdown()
+        kv.close()
+        observer.close()
+
+
+def test_injector_drives_apiserver_plane():
+    """The injector's apiserver plane: partition opens the reflector's
+    breaker (bounded probe cadence), heal closes it and syncs."""
+    from cilium_tpu.k8s.client import K8sClient, Reflector
+    from cilium_tpu.k8s.fake_apiserver import FakeAPIServer
+    from cilium_tpu.utils.resilience import CircuitBreaker
+
+    class _Sink:
+        def __init__(self):
+            self.events = []
+
+        def enqueue_event(self, kind, action, obj):
+            self.events.append((kind, action, obj))
+
+    fake = FakeAPIServer().start()
+    proxy = FaultProxy("127.0.0.1", fake.port).start()
+    inj = ControlPlaneFaultInjector(apiserver=proxy)
+    sink = _Sink()
+    reflector = Reflector(
+        K8sClient(f"http://127.0.0.1:{proxy.port}", timeout=2.0),
+        "/api/v1/nodes", "node", sink,
+        backoff_base=0.01, backoff_max=0.1,
+        breaker=CircuitBreaker("cp-chaos-k8s", failure_threshold=3,
+                               reset_timeout=0.1, max_reset=0.5))
+    try:
+        inj.partition("apiserver")
+        reflector.start()
+        _wait_for(lambda: reflector.breaker.state == "open",
+                  timeout=10.0, msg="reflector breaker open")
+        fake.upsert("nodes", {"metadata": {"name": "n1"}})
+        inj.heal("apiserver")
+        _wait_for(lambda: reflector.synced.is_set(), timeout=10.0,
+                  msg="reflector synced after heal")
+        _wait_for(lambda: reflector.breaker.state == "closed",
+                  timeout=10.0, msg="breaker closed after heal")
+    finally:
+        reflector.stop()
+        inj.close()
+        proxy.close()
+        fake.shutdown()
+
+
+# ---------------------------------------- disabled path / status fix
+
+def test_disabled_path_unwrapped_allocator_and_hard_failures():
+    """enable_kvstore_survival=False (the default): no fallback
+    allocator, no outage controller, and a dead backend surfaces hard
+    errors exactly as before the change."""
+    backend = _FlakyBackend()
+    d = Daemon(config=DaemonConfig(state_dir="",
+                                   drift_audit_interval_s=0,
+                                   ct_checkpoint_interval_s=0,
+                                   enable_hubble=False),
+               kvstore_backend=backend, node_name="n1")
+    try:
+        assert isinstance(d.identity_allocator,
+                          DistributedIdentityAllocator)
+        assert not isinstance(d.identity_allocator,
+                              FallbackIdentityAllocator)
+        assert d.controllers.lookup("kvstore-outage") is None
+        d.endpoint_create(1, ipv4=WEB_IP, labels=["k8s:id=web"])
+        backend.fail = True
+        # a NEW label set needs the kvstore: hard failure, no fallback
+        with pytest.raises(Exception):
+            d.endpoint_create(2, ipv4=DB_IP, labels=["k8s:id=db"])
+        # ... but the status path now reports the staleness instead of
+        # echoing 'ok' between calls (the satellite fix applies in
+        # monitor-only mode too)
+        st = d.status()["kvstore"]
+        assert st["mode"] == "ok"          # degradation is opt-in
+        assert st["staleness-seconds"] > 0
+        assert st["consecutive-failures"] >= 1
+        backend.fail = False
+        d.endpoint_create(2, ipv4=DB_IP, labels=["k8s:id=db"])
+        assert d.status()["kvstore"]["staleness-seconds"] == 0
+    finally:
+        backend.fail = False
+        d.shutdown()
+
+
+def test_controller_health_top_level_signal():
+    """A controller failing >=3x consecutively surfaces as a top-level
+    degraded signal in status(), and controller_runs_total counts
+    per-run outcomes."""
+    from cilium_tpu.utils.metrics import CONTROLLER_RUNS
+    d = Daemon(config=DaemonConfig(state_dir="",
+                                   drift_audit_interval_s=0,
+                                   ct_checkpoint_interval_s=0,
+                                   enable_hubble=False))
+    try:
+        assert d.status()["controller-health"]["status"] == "ok"
+        fails_before = CONTROLLER_RUNS.value(
+            labels={"name": "cp-chaos-wedged", "status": "failure"})
+
+        from cilium_tpu.utils.controller import ControllerParams
+
+        def boom():
+            raise RuntimeError("wedged reconcile")
+
+        d.controllers.update_controller(
+            "cp-chaos-wedged",
+            ControllerParams(do_func=boom, run_interval=0.01,
+                             error_retry_base=0.01))
+        _wait_for(lambda: d.status()["controller-health"]["failing"],
+                  timeout=10.0, msg="controller-health degraded")
+        ch = d.status()["controller-health"]
+        assert ch["status"].startswith("DEGRADED")
+        names = [f["name"] for f in ch["failing"]]
+        assert "cp-chaos-wedged" in names
+        wedged = next(f for f in ch["failing"]
+                      if f["name"] == "cp-chaos-wedged")
+        assert wedged["consecutive-failures"] >= 3
+        assert "wedged reconcile" in wedged["last-error"]
+        assert CONTROLLER_RUNS.value(
+            labels={"name": "cp-chaos-wedged",
+                    "status": "failure"}) > fails_before
+        # healing the controller clears the signal
+        d.controllers.update_controller(
+            "cp-chaos-wedged",
+            ControllerParams(do_func=lambda: None, run_interval=0.01))
+        _wait_for(lambda: not
+                  d.status()["controller-health"]["failing"],
+                  timeout=10.0, msg="controller-health ok again")
+    finally:
+        d.shutdown()
