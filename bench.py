@@ -180,6 +180,24 @@ def _smoke_result():
                           "promoted": 4, "regenerations": 4,
                           "naive_full_resync_regens": 20,
                           "regenerations_avoided": 16}}}}
+    # the dispatch-floor config's pinned output schema: per-batch-size
+    # flatten+dispatch probes (packed vs legacy-pytree) + end-to-end
+    # step times + the jitted-step leaf-count reduction
+    row = lambda r: {  # noqa: E731 — schema fixture
+        "legacy_dispatch_p50_us": 11.7, "packed_dispatch_p50_us": 6.8,
+        "reduction": r, "legacy_step_p50_us": 545.0,
+        "packed_step_p50_us": 583.4}
+    suite["dispatch-floor"] = {
+        "metric": "dispatch_floor_reduction_b256", "value": 1.74,
+        "unit": "x", "vs_baseline": 1.16,
+        "extra": {"smoke": True,
+                  "per_batch_us": {"1": row(1.77), "256": row(1.74),
+                                   "4096": row(1.98)},
+                  "leaf_counts": {"packed-step": 8, "v6-step": 17,
+                                  "legacy-step": 36, "reduction": 4.5},
+                  "reduction_floor_met": True,
+                  "pack_stats": {"full-packs": 1, "row-writes": 0,
+                                 "leaf-writes": 0}}}
     # the latency-tier config's pinned output schema: per-batch-size
     # sync vs serving p50/p99 plus the coalescing block
     suite["latency-tier"] = {
@@ -231,11 +249,17 @@ def run_bench():
 
     # Persistent compilation cache: a re-run after a relay flake (or the
     # watchdog's CPU fallback re-exec) skips the 20-40s first-compile.
-    # Keyed per backend so a CPU fallback never loads artifacts traced
-    # under different machine features (XLA warns about SIGILL risk).
+    # Keyed per backend AND per jax version + machine so a stale cache
+    # can never serve executables traced under a different build or
+    # different CPU features: deserializing such artifacts was root-
+    # caused to glibc heap corruption (malloc largebin aborts striking
+    # configs later in the run — reproduced on unmodified builds until
+    # the stale dir was cleared).
     try:
+        import platform
+        key = f"{backend}_{jax.__version__}_{platform.machine()}"
         jax.config.update("jax_compilation_cache_dir",
-                          f"/tmp/cilium_tpu_jax_cache_{backend}")
+                          f"/tmp/cilium_tpu_jax_cache_{key}")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     except Exception:  # noqa: BLE001 — cache is best-effort
         pass
@@ -443,12 +467,17 @@ def run_bench():
         import bench_suite
         # latency-tier leads: the serving-path latency claim must
         # never be the config the time budget drops; overload rides
-        # right behind it (the survivable-serving admission claim)
-        for name in ("latency-tier", "overload", "mesh-shard",
-                     "control-churn",
+        # right behind it (the survivable-serving admission claim).
+        # control-churn runs LAST: the one config that spins a live
+        # daemon + MiniEtcd + fault proxies inside this process stays
+        # downstream of every micro-bench, so its background threads
+        # and teardown can never perturb their measurements
+        for name in ("latency-tier", "dispatch-floor", "overload",
+                     "mesh-shard",
                      "identity-l4", "http-regex", "kafka-acl", "fqdn",
                      "capacity", "incremental", "flows-overhead",
-                     "tracing-overhead", "provenance-overhead"):
+                     "tracing-overhead", "provenance-overhead",
+                     "control-churn"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"
                 continue

@@ -8,7 +8,6 @@ reference (bpf_lxc.c egress/ingress) as one jitted program.
 
 from __future__ import annotations
 
-import functools
 import threading
 
 from ..utils.lock import Mutex
@@ -27,7 +26,7 @@ from ..observability.pressure import compute_pressure
 from ..observability.stages import record_stage
 from ..policy.mapstate import PolicyMapState
 from ..utils.metrics import POLICY_VERDICTS
-from .conntrack import ConntrackTable, make_ct_state
+from .conntrack import ConntrackTable, ct_host_fields
 from .lb import (CompiledLB, CompiledLB6, LoadBalancer, Service,
                  Service6, compile_lb, compile_lb6)
 from .pipeline import (DatapathTables, FullPacketBatch, FullPacketBatch6,
@@ -36,7 +35,8 @@ from .pipeline import (DatapathTables, FullPacketBatch, FullPacketBatch6,
                        full_datapath_step_packed, lpm6_tables)
 from .events import format_rule
 from .prefilter import PreFilter
-from .verdict import Counters, Provenance, _explain_jit, make_packet_batch
+from .verdict import (Counters, Provenance, _explain_jit,
+                      make_counter_pack, make_packet_batch)
 
 
 class Datapath:
@@ -56,9 +56,14 @@ class Datapath:
         self._lock = Mutex("datapath")
         self.prefilter = PreFilter()
         self.lb = LoadBalancer()
-        self.ct = ConntrackTable(slots=ct_slots, max_probe=ct_probe)
+        # packed CT representation ([8, N+1] buffers): ONE jitted-step
+        # leaf per family instead of eight (the dispatch floor fix —
+        # parallel/packing.py); snapshots keep the per-field layout
+        self.ct = ConntrackTable(slots=ct_slots, max_probe=ct_probe,
+                                 packed=True)
         # separate v6 CT table (the reference keeps ct6 apart from ct4)
-        self.ct6 = ConntrackTable(slots=ct_slots, max_probe=ct_probe)
+        self.ct6 = ConntrackTable(slots=ct_slots, max_probe=ct_probe,
+                                  packed=True)
         self.compiled_policy: Optional[CompiledPolicy] = None
         self.compiled_ipcache: Optional[CompiledLPM] = None
         self.compiled_ipcache6: Optional[CompiledLPM6] = None
@@ -79,7 +84,9 @@ class Datapath:
         # endpoint slot -> the endpoint's own security identity (the
         # per-endpoint SECLABEL the encap stage stamps into tunnel keys)
         self._ep_identity = np.zeros(8, np.int32)
-        self.counters: Optional[Counters] = None
+        # packed per-entry counters ([2, E*S] uint32; verdict.py
+        # make_counter_pack) — read through the ``counters`` property
+        self._counters = None
         self.revision = 0
         self._step = None
         self._step_packed = None
@@ -87,6 +94,22 @@ class Datapath:
         self._tables: Optional[FullTables] = None
         self._step6 = None
         self._tables6: Optional[FullTables6] = None
+        # the dispatch-floor packing (parallel/packing.py): the table
+        # leaf zoo concatenated into a handful of grouped flat device
+        # buffers, cached across steps and dispatched instead of the
+        # ~30 FullTables leaves; re-packed only on table generation
+        # change (delta-applies write through to the packed slices)
+        self._manifest4 = None
+        self._manifest6 = None
+        self._tbufs4 = None
+        self._tbufs6 = None
+        self._rw4 = None           # (jitted row writer, group index)
+        self._rw6 = None
+        self._statics4: Dict = {}  # the jitted steps' static kwargs —
+        self._statics6: Dict = {}  # exposed for the legacy-pytree
+        #                            bench/parity twins
+        self._pack_stats = {"full-packs": 0, "row-writes": 0,
+                            "leaf-writes": 0}
         # the node's v6 router IP words (icmp6.h ROUTER_IP): the
         # address whose NS/echo the datapath answers itself
         self._router_ip6 = None
@@ -145,6 +168,15 @@ class Datapath:
         self.last_provenance: Optional[Provenance] = None
         self._replay_probe = 1
         self._prov_decode_cache = None
+
+    @property
+    def counters(self) -> Optional[Counters]:
+        """Counters view over the packed [2, E*S] buffer (row slices;
+        the observability/test surface — dispatch uses the pack)."""
+        c = self._counters
+        if c is None:
+            return None
+        return Counters(packets=c[0], bytes=c[1])
 
     def enable_flow_aggregation(self, slots: int = 1 << 12,
                                 max_probe: int = 8,
@@ -230,6 +262,8 @@ class Datapath:
             if self._tables6 is not None:
                 self._tables6 = self._tables6._replace(
                     router_ip6=self._router_ip6)
+                self._write_leaf_locked("router_ip6", self._router_ip6,
+                                        families=("6",))
 
     def icmp6_echo_reply_bytes(self, requester_ip6: str,
                                ident: int = 0, seq: int = 0) -> bytes:
@@ -285,8 +319,8 @@ class Datapath:
         self.ct6.state = jax.device_put(self.ct6.state, rep)
         if self.flows is not None:
             self.flows.state = jax.device_put(self.flows.state, rep)
-        if self.counters is not None:
-            self.counters = jax.device_put(self.counters, rep)
+        if self._counters is not None:
+            self._counters = jax.device_put(self._counters, rep)
 
     # -- table loading -------------------------------------------------------
 
@@ -325,10 +359,17 @@ class Datapath:
                 self.compiled_ipcache = compile_lpm(ipcache_prefixes or {})
             self._rebuild()
 
-    def refresh_policy(self, revision: Optional[int] = None) -> bool:
+    def refresh_policy(self, revision: Optional[int] = None,
+                       force_rebuild: bool = False) -> bool:
         """Realize the table manager's current tensors (the syncPolicyMap
         fast path: no recompile when geometry is unchanged). Returns
-        True when a full re-jit happened."""
+        True when a full re-jit happened.  On the fast path the
+        manager's dirty rows are written through to the packed dispatch
+        buffers (row scatters — a single-rule delta never repacks the
+        table stack).  ``force_rebuild`` forces the full rebuild +
+        repack (the supervisor's recovery path: corrupted device
+        buffers must be rebuilt from the host-of-record even when
+        geometry is unchanged)."""
         with self._lock:
             if self._table_mgr is None:
                 raise RuntimeError("not in table-manager mode")
@@ -338,7 +379,8 @@ class Datapath:
             # sync_endpoint can lengthen probe chains in-place and a
             # grow can reshape the stack between separate reads
             geometry, tensors = self._table_mgr.snapshot()
-            if geometry != self._mgr_geometry or self._step is None:
+            if force_rebuild or geometry != self._mgr_geometry \
+                    or self._step is None:
                 self._rebuild(mgr_snapshot=(geometry, tensors))
                 return True
             key_id, key_meta, value = tensors
@@ -352,7 +394,38 @@ class Datapath:
             if self._tables6 is not None:
                 self._tables6 = self._tables6._replace(
                     key_id=key_id, key_meta=key_meta, value=value)
+            self._apply_dirty_rows_locked()
             return False
+
+    def _apply_dirty_rows_locked(self) -> None:
+        """Delta-apply write-through: scatter the table manager's dirty
+        endpoint rows into the packed policy slices of BOTH family
+        packs (v6 shares the policy tensors).  Lock held."""
+        mgr = self._table_mgr
+        if mgr is None or self._tbufs4 is None:
+            return
+        dirty = mgr.drain_dirty()
+        if not dirty:
+            return
+        telem = self.telemetry_enabled
+        t0 = time.perf_counter() if telem else 0.0
+        slots = jnp.asarray(np.fromiter(dirty, np.int32,
+                                        count=len(dirty)))
+        kid = jnp.asarray(np.stack([r[0] for r in dirty.values()]))
+        kmeta = jnp.asarray(np.stack([r[1] for r in dirty.values()]))
+        kval = jnp.asarray(np.stack([r[2] for r in dirty.values()]))
+        for attr, rw in (("_tbufs4", self._rw4), ("_tbufs6", self._rw6)):
+            bufs = getattr(self, attr)
+            if bufs is None or rw is None:
+                continue
+            writer, gidx = rw
+            out = list(bufs)
+            out[gidx] = writer(out[gidx], slots, kid, kmeta, kval)
+            setattr(self, attr, tuple(out))
+        self._pack_stats["row-writes"] += len(dirty)
+        if telem:
+            record_stage("engine", "flatten",
+                         time.perf_counter() - t0)
 
     def load_ipcache(self, prefixes: Dict[str, int],
                      prefixes6: Optional[Dict[str, int]] = None) -> None:
@@ -436,6 +509,36 @@ class Datapath:
             if self._tables6 is not None:
                 self._tables6 = self._tables6._replace(
                     ep_identity=ep_ident)
+            self._write_leaf_locked("ep_identity", ep_ident)
+
+    def _write_leaf_locked(self, path: str, arr,
+                           families: Tuple[str, ...] = ("4", "6")
+                           ) -> None:
+        """Write one table leaf through to the packed dispatch buffers
+        (region writes; lock held).  A shape change — or the leaf being
+        absent from a target family's manifest (it just came into
+        existence) — means the packing manifest and therefore the
+        jitted program changed: full rebuild."""
+        if self._tbufs4 is None:
+            return
+        from ..parallel import packing
+        updates = {}
+        for fam in families:
+            manifest = self._manifest4 if fam == "4" else self._manifest6
+            bufs = self._tbufs4 if fam == "4" else self._tbufs6
+            if manifest is None or bufs is None:
+                continue
+            new = packing.write_leaf(manifest, bufs, path, arr)
+            if new is None:
+                self._rebuild()  # manifest change: re-pack + re-jit
+                return
+            updates[fam] = new
+        if "4" in updates:
+            self._tbufs4 = updates["4"]
+        if "6" in updates:
+            self._tbufs6 = updates["6"]
+        if updates:
+            self._pack_stats["leaf-writes"] += 1
 
     def reload_services(self) -> None:
         with self._lock:
@@ -454,7 +557,8 @@ class Datapath:
             record_stage("engine", "table-build",
                          time.perf_counter() - t0)
             nbytes = 0
-            for tables in (self._tables, self._tables6):
+            for tables in (self._tables, self._tables6,
+                           self._tbufs4, self._tbufs6):
                 for leaf in jax.tree_util.tree_leaves(tables):
                     nbytes += int(getattr(leaf, "nbytes", 0))
             jit_telemetry.set_device_bytes("engine-tables", nbytes)
@@ -508,9 +612,8 @@ class Datapath:
             pf_key_b=jnp.asarray(pf.key_b), pf_value=jnp.asarray(pf.value),
             pf_plens=jnp.asarray(pf.prefix_lens),
             ep_identity=ep_ident, **tun_kwargs)
-        if self.counters is None or self.counters.packets.shape[0] != n:
-            self.counters = Counters(packets=jnp.zeros(n, jnp.uint32),
-                                     bytes=jnp.zeros(n, jnp.uint32))
+        if self._counters is None or self._counters.shape[1] != n:
+            self._counters = make_counter_pack(n)
         flow_kwargs = {}
         if self.flows is not None:
             flow_kwargs = dict(flow_slots=self.flows.slots,
@@ -535,24 +638,7 @@ class Datapath:
             lb_probe=self.lb.compiled.max_probe,
             ct_slots=self.ct.slots, ct_probe=self.ct.max_probe,
             tun_probe=tun_probe)
-        self._step = jax.jit(functools.partial(
-            full_datapath_step, **v4_static, **flow_kwargs),
-            donate_argnums=(1, 2))
-        # the claim-free (admission-striped) variant; compiled lazily
-        # on first use like every jitted step
-        self._step_nc = None if self.flows is None else jax.jit(
-            functools.partial(full_datapath_step, **v4_static,
-                              **flow_kwargs, flow_claim_budget=0),
-            donate_argnums=(1, 2))
-        # the serving path's packed twins: same program over a single
-        # [10, B] field matrix (one H2D per batch instead of ten)
-        self._step_packed = jax.jit(functools.partial(
-            full_datapath_step_packed, **v4_static, **flow_kwargs),
-            donate_argnums=(1, 2))
-        self._step_packed_nc = None if self.flows is None else jax.jit(
-            functools.partial(full_datapath_step_packed, **v4_static,
-                              **flow_kwargs, flow_claim_budget=0),
-            donate_argnums=(1, 2))
+        self._statics4 = {**v4_static, **flow_kwargs}
 
         # v6 twin: shares the (family-agnostic) policy tensors, runs
         # the 4-word LPMs for prefilter/ipcache and its own CT table.
@@ -573,13 +659,7 @@ class Datapath:
             pf6_probe=max(1, pf6.max_probe),
             ct_slots=self.ct6.slots, ct_probe=self.ct6.max_probe,
             lb6_probe=lb6.max_probe if lb6 is not None else 0)
-        self._step6 = jax.jit(functools.partial(
-            full_datapath_step6, **v6_static, **flow_kwargs),
-            donate_argnums=(1, 2))
-        self._step6_nc = None if self.flows is None else jax.jit(
-            functools.partial(full_datapath_step6, **v6_static,
-                              **flow_kwargs, flow_claim_budget=0),
-            donate_argnums=(1, 2))
+        self._statics6 = {**v6_static, **flow_kwargs}
 
         # mesh placement: commit every table onto this shard's column
         # submesh so the jitted steps compile as submesh-resident SPMD
@@ -588,7 +668,113 @@ class Datapath:
             rep = self._replicated_sharding
             self._tables = jax.device_put(self._tables, rep)
             self._tables6 = jax.device_put(self._tables6, rep)
-            self.counters = jax.device_put(self.counters, rep)
+            self._counters = jax.device_put(self._counters, rep)
+
+        # pack the table leaf zoo into the grouped dispatch buffers
+        # (the dispatch-floor fix): every jitted step below takes the
+        # handful of flat buffers instead of the ~30-leaf pytree, with
+        # the per-leaf views rebuilt INSIDE the compiled program
+        self._refresh_packs_locked()
+
+        def grouped(step_fn, unpack, statics):
+            def g(tbufs, ct, counters, batch, now, flows=None):
+                tables = unpack(tbufs)
+                if flows is None:
+                    return step_fn(tables, ct, counters, batch, now,
+                                   **statics)
+                return step_fn(tables, ct, counters, batch, now,
+                               flows, **statics)
+            return jax.jit(g, donate_argnums=(1, 2))
+
+        from ..parallel import packing
+        unpack4 = packing.unpacker(self._manifest4)
+        unpack6 = packing.unpacker(self._manifest6)
+        nc4 = dict(self._statics4, flow_claim_budget=0)
+        nc6 = dict(self._statics6, flow_claim_budget=0)
+        self._step = grouped(full_datapath_step, unpack4,
+                             self._statics4)
+        # the claim-free (admission-striped) variants; compiled lazily
+        # on first use like every jitted step
+        self._step_nc = None if self.flows is None else grouped(
+            full_datapath_step, unpack4, nc4)
+        # the serving path's packed twins: same program over a single
+        # [10, B] field matrix (one H2D per batch instead of ten)
+        self._step_packed = grouped(full_datapath_step_packed, unpack4,
+                                    self._statics4)
+        self._step_packed_nc = None if self.flows is None else grouped(
+            full_datapath_step_packed, unpack4, nc4)
+        self._step6 = grouped(full_datapath_step6, unpack6,
+                              self._statics6)
+        self._step6_nc = None if self.flows is None else grouped(
+            full_datapath_step6, unpack6, nc6)
+
+    def _refresh_packs_locked(self) -> None:
+        """(Re)build the packed dispatch buffers from the live tables
+        (lock held): manifest from the canonical PartitionSpec registry,
+        one device concat per group.  Paid per table generation — never
+        per batch; the per-batch flatten cost this kills is recorded
+        here as the non-blocking ``flatten`` stage."""
+        from ..parallel import packing
+        telem = self.telemetry_enabled
+        t0 = time.perf_counter() if telem else 0.0
+        self._manifest4 = packing.build_manifest(self._tables)
+        self._manifest6 = packing.build_manifest(self._tables6)
+        bufs4 = packing.pack_groups(self._tables, self._manifest4)
+        bufs6 = packing.pack_groups(self._tables6, self._manifest6)
+        if self._placement is not None:
+            rep = self._replicated_sharding
+            bufs4 = tuple(jax.device_put(b, rep) for b in bufs4)
+            bufs6 = tuple(jax.device_put(b, rep) for b in bufs6)
+        self._tbufs4, self._tbufs6 = bufs4, bufs6
+        self._rw4 = packing.make_policy_row_writer(self._manifest4)
+        self._rw6 = packing.make_policy_row_writer(self._manifest6)
+        self._pack_stats["full-packs"] += 1
+        if telem:
+            record_stage("engine", "flatten",
+                         time.perf_counter() - t0)
+
+    def pack_stats(self) -> Dict:
+        """Packing accounting: full group repacks vs delta row/leaf
+        write-throughs, plus the group layout."""
+        with self._lock:
+            out = dict(self._pack_stats)
+            if self._manifest4 is not None:
+                out["groups4"] = list(self._manifest4.group_names())
+                out["groups6"] = list(self._manifest6.group_names())
+        return out
+
+    def dispatch_leaf_counts(self) -> Dict[str, int]:
+        """Flattened jitted-step argument leaf counts: what the packed
+        dispatch actually marshals per batch vs what the legacy pytree
+        form would — the sharding lint pins the ceiling so new leaves
+        can't silently regrow the dispatch floor."""
+        from jax.tree_util import tree_leaves
+        with self._lock:
+            if self._step_packed is None:
+                raise RuntimeError("no policy loaded")
+            flows = () if self.flows is None else (self.flows.state,)
+            packed_args = (self._tbufs4, self.ct.state, self._counters,
+                           np.zeros((10, 1), np.int32), 0) + flows
+            n_packed = len(tree_leaves(packed_args))
+            # v6 keeps the per-field packet batch (10 leaves) but the
+            # same grouped tables/state
+            n_v6 = (len(tree_leaves((self._tbufs6, self.ct6.state,
+                                     self._counters))) + 10 + 1
+                    + len(tree_leaves(flows)))
+            # the legacy-pytree equivalent: raw table leaves + per-leaf
+            # CT state + per-leaf counters + batch + timestamp
+            n_legacy = (len(tree_leaves(self._tables)) + 8 + 2 + 1 + 1
+                        + len(tree_leaves(flows)))
+            return {"packed-step": n_packed,
+                    "v6-step": n_v6,
+                    "legacy-step": n_legacy,
+                    "reduction": round(n_legacy / n_packed, 2)}
+
+    def _lower_args_packed(self, packed, now: int = 1):
+        """The exact argument tuple ``_step_packed`` dispatches —
+        the jit-lowering/introspection surface for tests."""
+        return (self._tbufs4, self.ct.state, self._counters, packed,
+                jnp.int32(now))
 
     # -- the hot path --------------------------------------------------------
 
@@ -633,14 +819,14 @@ class Datapath:
             if self.flows is not None:
                 step = self._flow_step_variant(self._step,
                                                self._step_nc)
-                outs = step(self._tables, self.ct.state, self.counters,
+                outs = step(self._tbufs4, self.ct.state, self._counters,
                             pkt, ts, self.flows.state)
             else:
                 step = self._step
-                outs = step(self._tables, self.ct.state, self.counters,
+                outs = step(self._tbufs4, self.ct.state, self._counters,
                             pkt, ts)
             verdict, event, identity, nat = outs[:4]
-            self.ct.state, self.counters = outs[4], outs[5]
+            self.ct.state, self._counters = outs[4], outs[5]
             tail = 6
             if self.flows is not None:
                 self.flows.state = outs[tail]
@@ -672,14 +858,14 @@ class Datapath:
             if self.flows is not None:
                 step = self._flow_step_variant(self._step6,
                                                self._step6_nc)
-                outs = step(self._tables6, self.ct6.state,
-                            self.counters, pkt, ts, self.flows.state)
+                outs = step(self._tbufs6, self.ct6.state,
+                            self._counters, pkt, ts, self.flows.state)
             else:
                 step = self._step6
-                outs = step(self._tables6, self.ct6.state,
-                            self.counters, pkt, ts)
+                outs = step(self._tbufs6, self.ct6.state,
+                            self._counters, pkt, ts)
             verdict, event, identity, nat = outs[:4]
-            self.ct6.state, self.counters = outs[4], outs[5]
+            self.ct6.state, self._counters = outs[4], outs[5]
             tail = 6
             if self.flows is not None:
                 self.flows.state = outs[tail]
@@ -718,14 +904,14 @@ class Datapath:
             if self.flows is not None:
                 step = self._flow_step_variant(self._step_packed,
                                                self._step_packed_nc)
-                outs = step(self._tables, self.ct.state, self.counters,
+                outs = step(self._tbufs4, self.ct.state, self._counters,
                             packed, ts, self.flows.state)
             else:
                 step = self._step_packed
-                outs = step(self._tables, self.ct.state, self.counters,
+                outs = step(self._tbufs4, self.ct.state, self._counters,
                             packed, ts)
             verdict, event, identity, nat = outs[:4]
-            self.ct.state, self.counters = outs[4], outs[5]
+            self.ct.state, self._counters = outs[4], outs[5]
             tail = 6
             if self.flows is not None:
                 self.flows.state = outs[tail]
@@ -1116,16 +1302,17 @@ class Datapath:
         if name == "hubble-flows":
             return flows.snapshot(max_entries)
         if name in ("ct", "ct6"):
-            k3 = np.asarray(st.k3)
+            flds = ct_host_fields(st)
+            k3 = flds["k3"]
             # exclude the sentinel slot (the last row absorbs no-op
             # scatters; entry_count has the same exclusion)
             idx = np.flatnonzero(k3[:-1])[:max_entries]
-            k0 = np.asarray(st.k0).astype(np.uint32)
-            k1 = np.asarray(st.k1).astype(np.uint32)
-            k2 = np.asarray(st.k2).astype(np.uint32)
-            exp = np.asarray(st.expires)
-            rn = np.asarray(st.rev_nat)
-            pp = np.asarray(st.proxy_port)
+            k0 = flds["k0"].astype(np.uint32)
+            k1 = flds["k1"].astype(np.uint32)
+            k2 = flds["k2"].astype(np.uint32)
+            exp = flds["expires"]
+            rn = flds["rev_nat"]
+            pp = flds["proxy_port"]
             return [{
                 "saddr": int(k0[i]), "daddr": int(k1[i]),
                 "sport": int(k2[i] >> 16),

@@ -540,7 +540,10 @@ class DeviceSupervisor:
         dp = self.datapath
         try:
             if getattr(dp, "_table_mgr", None) is not None:
-                dp.refresh_policy()
+                # force_rebuild: recovery must regenerate the packed
+                # dispatch buffers too — a corrupted device buffer is
+                # exactly what the fast (write-through) path would keep
+                dp.refresh_policy(force_rebuild=True)
             else:
                 dp.reload_services()  # full _rebuild from compiled
         except Exception as e:  # noqa: BLE001 — rebuild failed: the

@@ -49,6 +49,13 @@ class Counters(NamedTuple):
     bytes: jnp.ndarray    # [E*S] uint32
 
 
+def make_counter_pack(n: int) -> jnp.ndarray:
+    """The packed counter representation: ONE [2, E*S] uint32 buffer
+    (row 0 packets, row 1 bytes) — a single donated jitted-step leaf
+    instead of two (the dispatch-floor packing, parallel/packing.py)."""
+    return jnp.zeros((2, max(1, n)), jnp.uint32)
+
+
 class Provenance(NamedTuple):
     """Per-packet verdict provenance (both [B] int32): the flat slot
     of the matched policymap entry in the stacked [E*S] tables (-1 =
@@ -139,14 +146,19 @@ def verdict_step(key_id: jnp.ndarray, key_meta: jnp.ndarray,
     hit = f1 | f2 | f3
     hit_slot = jnp.where(f1, s1, jnp.where(f2, s2, s3))
     # Per-entry counters (policy.h:67-101 packets/bytes adds). Misses
-    # scatter into slot 0 with weight 0 (no-op).
+    # scatter into slot 0 with weight 0 (no-op).  ``counters`` is the
+    # Counters pytree or the packed [2, E*S] buffer (make_counter_pack)
+    # — identical scatter-adds either way, resolved at trace time.
     counted = hit if count_mask is None else (hit & count_mask)
     inc_p = counted.astype(jnp.uint32)
     inc_b = jnp.where(counted, pkt.length.astype(jnp.uint32),
                       jnp.uint32(0))
-    packets = counters.packets.at[hit_slot].add(inc_p)
-    bytes_ = counters.bytes.at[hit_slot].add(inc_b)
-    out = Counters(packets=packets, bytes=bytes_)
+    if isinstance(counters, Counters):
+        out = Counters(packets=counters.packets.at[hit_slot].add(inc_p),
+                       bytes=counters.bytes.at[hit_slot].add(inc_b))
+    else:
+        out = counters.at[0, hit_slot].add(inc_p) \
+                      .at[1, hit_slot].add(inc_b)
     if with_provenance:
         prov = _policy_provenance(pkt, f1, v1, s1, f2, s2, f3, v3, s3)
         return verdict, out, prov.match_slot, prov.tier

@@ -73,6 +73,10 @@ class DeviceTableManager:
         self.revision = 0             # policy revision last synced
         self.max_probe = 1
         self._row_probe: Dict[int, int] = {}
+        # rows written since the last drain: the engine's packed-buffer
+        # write-through (refresh_policy fast path) realizes exactly
+        # these as row scatters instead of repacking the whole stack
+        self._dirty_slots: set = set()
         self._free: List[int] = list(range(initial_endpoints))
         self._slot_of: Dict[int, int] = {}   # endpoint id -> row
         self._state_of: Dict[int, PolicyMapState] = {}
@@ -166,6 +170,7 @@ class DeviceTableManager:
         self._h_key_id[slot] = key_a
         self._h_key_meta[slot] = key_b
         self._h_value[slot] = value
+        self._dirty_slots.add(slot)
         self._row_probe[slot] = probe
         new_probe = max([1] + list(self._row_probe.values()))
         s = jnp.int32(slot)
@@ -231,6 +236,25 @@ class DeviceTableManager:
             return ((self.capacity, self.slots, self.max_probe,
                      self.generation),
                     (self.key_id, self.key_meta, self.value))
+
+    def drain_dirty(self) -> Dict[int, Tuple[np.ndarray, np.ndarray,
+                                             np.ndarray]]:
+        """{slot: (key_id row, key_meta row, value row)} for every row
+        written since the last drain, from the host mirror (always the
+        newest content), clearing the dirty set.  The engine's packed
+        write-through consumes this on the refresh_policy fast path;
+        rows are idempotent to re-apply, so draining after a full
+        rebuild only costs a redundant scatter, never staleness."""
+        with self._lock:
+            out = {}
+            for slot in sorted(self._dirty_slots):
+                if slot >= self._h_key_id.shape[0]:
+                    continue
+                out[slot] = (self._h_key_id[slot].copy(),
+                             self._h_key_meta[slot].copy(),
+                             self._h_value[slot].copy())
+            self._dirty_slots.clear()
+            return out
 
     def host_mirror(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         with self._lock:

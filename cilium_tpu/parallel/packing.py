@@ -1,0 +1,244 @@
+"""The dispatch-floor packing manifest: the table leaf zoo collapsed
+into a handful of grouped flat device buffers.
+
+PR 7 measured that flattening and dispatching the ``FullTables``/CT/
+flow/counter leaves costs roughly half of the per-batch CPU dispatch
+floor — ~40 pytree leaves marshalled host-side on EVERY jitted-step
+call, on every backend and on every shard of the mesh.  This module is
+the hXDP-style compaction of what crosses the host->device dispatch
+boundary: the canonical PartitionSpec registry (``parallel/specs.py``)
+already enumerates every table leaf, so it doubles as the packing
+manifest — leaves group by (sharding class, dtype) into concatenated
+flat buffers, and the per-leaf views are reconstructed *inside* the
+jitted program from static offsets (XLA fuses the slicing away; the
+compiled math is unchanged, only argument marshalling moves).
+
+Groups:
+
+* ``ep-<dtype>``  — endpoint-axis-sharded leaves (the stacked policy
+  tables + per-slot identities): one flat buffer per shard slice.
+* ``rep-<dtype>`` — replicated address-keyed leaves (ipcache/LPM, LB,
+  prefilter, tunnel): every shard holds a full copy.
+* ``ct-state`` / ``counters`` — the donated mutable state packs owned
+  by ``datapath/conntrack.py`` and the engine ([8, N+1] and [2, E*S]
+  matrices; packed natively, no per-step repack).
+
+Every group name must carry a declared PartitionSpec in
+``specs.PACKED_GROUP_SPECS`` — held by ``tests/test_sharding_lint.py``
+alongside the jitted-step leaf-count ceiling, so new leaves can't
+silently regrow the dispatch floor.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+# the engine-owned mutable state packs (not manifest-built, but part
+# of the same lint-enforced group namespace)
+CT_STATE_GROUP = "ct-state"
+COUNTERS_GROUP = "counters"
+
+
+class LeafSlot(NamedTuple):
+    """One table leaf's view into its group buffer."""
+
+    path: str                 # dotted leaf path (specs.py convention)
+    group: str                # owning group buffer name
+    offset: int               # flat element offset inside the group
+    size: int                 # element count
+    shape: Tuple[int, ...]    # static view shape
+
+
+class GroupSpec(NamedTuple):
+    name: str                 # "<class>-<dtype>", e.g. "ep-int32"
+    dtype: str
+    size: int                 # total flat elements
+
+
+class PackManifest(NamedTuple):
+    """Static packing layout for one table class instance.  Pure
+    tuples: hashable and comparable, so geometry changes are detected
+    by manifest inequality."""
+
+    cls_name: str
+    leaves: Tuple[LeafSlot, ...]
+    groups: Tuple[GroupSpec, ...]
+
+    def group_names(self) -> Tuple[str, ...]:
+        return tuple(g.name for g in self.groups)
+
+    def leaf_count(self) -> int:
+        return len(self.leaves)
+
+    def leaf(self, path: str) -> Optional[LeafSlot]:
+        for l in self.leaves:
+            if l.path == path:
+                return l
+        return None
+
+
+def _classes():
+    from ..datapath.pipeline import FullTables, FullTables6
+    return {"FullTables": FullTables, "FullTables6": FullTables6}
+
+
+def _nested_for(cls_name: str) -> Dict[str, type]:
+    from ..datapath.lb import LB6Tables, LBTables
+    from ..datapath.pipeline import DatapathTables, LPM6Tables
+    return {
+        "FullTables": {"datapath": DatapathTables, "lb": LBTables},
+        "FullTables6": {"ipcache6": LPM6Tables, "pf6": LPM6Tables,
+                        "lb6": LB6Tables},
+    }.get(cls_name, {})
+
+
+def _walk(obj, prefix: str = ""):
+    """(dotted path, array) for every present (non-None) leaf, in
+    field-declaration order — the stable packing order."""
+    for f in type(obj)._fields:
+        v = getattr(obj, f)
+        if v is None:
+            continue
+        if hasattr(v, "_fields"):
+            yield from _walk(v, prefix + f + ".")
+        else:
+            yield prefix + f, v
+
+
+def _sharding_class(spec) -> str:
+    """ep (endpoint-axis sharded) vs rep (replicated): any mesh axis
+    in the declared spec means the leaf's rows belong to one shard."""
+    for axis in spec:
+        if axis is not None:
+            return "ep"
+    return "rep"
+
+
+def build_manifest(tables) -> PackManifest:
+    """Packing manifest for one table instance, grouped by (declared
+    sharding class, dtype) from the canonical spec registry.  A leaf
+    without a registry entry is an error here exactly like it is in
+    the sharding lint — new leaves must declare their distribution."""
+    from . import specs
+    cls_name = type(tables).__name__
+    spec_table = specs.registry()[cls_name]
+    leaves: List[LeafSlot] = []
+    offsets: Dict[str, int] = {}
+    dtypes: Dict[str, str] = {}
+    for path, arr in _walk(tables):
+        spec = spec_table[path]
+        dt = str(arr.dtype)
+        group = f"{_sharding_class(spec)}-{dt}"
+        off = offsets.get(group, 0)
+        size = int(arr.size)
+        leaves.append(LeafSlot(path=path, group=group, offset=off,
+                               size=size, shape=tuple(arr.shape)))
+        offsets[group] = off + size
+        dtypes[group] = dt
+    groups = tuple(GroupSpec(name=g, dtype=dtypes[g], size=offsets[g])
+                   for g in offsets)
+    return PackManifest(cls_name=cls_name, leaves=tuple(leaves),
+                        groups=groups)
+
+
+def pack_groups(tables, manifest: PackManifest
+                ) -> Tuple[jnp.ndarray, ...]:
+    """Concatenate the leaves into their group buffers (device concat;
+    control-plane cost, paid once per table generation — never per
+    batch).  Returns buffers ordered like ``manifest.groups``."""
+    vals = dict(_walk(tables))
+    out = []
+    for g in manifest.groups:
+        parts = [vals[l.path].reshape(-1)
+                 for l in manifest.leaves if l.group == g.name]
+        out.append(parts[0] if len(parts) == 1
+                   else jnp.concatenate(parts))
+    return tuple(out)
+
+
+def unpacker(manifest: PackManifest):
+    """Closure rebuilding the table NamedTuple from the group buffers
+    INSIDE the jitted program: static slices + reshapes that XLA fuses
+    into the consuming gathers — the per-batch flatten cost moves into
+    the compiled program where it is free."""
+    cls = _classes()[manifest.cls_name]
+    nested = _nested_for(manifest.cls_name)
+    names = manifest.group_names()
+
+    def unpack(bufs: Tuple[jnp.ndarray, ...]):
+        by_group = dict(zip(names, bufs))
+        vals = {l.path: by_group[l.group][l.offset:l.offset + l.size]
+                .reshape(l.shape) for l in manifest.leaves}
+        kwargs = {}
+        for f in cls._fields:
+            sub_cls = nested.get(f)
+            if sub_cls is not None:
+                pref = f + "."
+                sub = {p[len(pref):]: v for p, v in vals.items()
+                       if p.startswith(pref)}
+                kwargs[f] = sub_cls(**sub) if sub else None
+            else:
+                kwargs[f] = vals.get(f)
+        return cls(**kwargs)
+
+    return unpack
+
+
+# ---------------------------------------------------------------------------
+# Delta-apply write-through: one endpoint row -> three scatters into
+# the packed policy slices, no full repack.
+# ---------------------------------------------------------------------------
+
+_POLICY_ROWS = {  # canonical name -> leaf path per table class
+    "FullTables": ("datapath.key_id", "datapath.key_meta",
+                   "datapath.value"),
+    "FullTables6": ("key_id", "key_meta", "value"),
+}
+
+
+def make_policy_row_writer(manifest: PackManifest):
+    """(jitted writer, group index) realizing dirty endpoint rows in
+    the packed policy slices: ``writer(buf, slots [D], kid [D, S],
+    kmeta [D, S], kval [D, S]) -> buf``.  One scatter covers all three
+    regions; the single-rule delta stays a row write, never a repack."""
+    import jax
+
+    paths = _POLICY_ROWS[manifest.cls_name]
+    slots_ = [manifest.leaf(p) for p in paths]
+    if any(l is None for l in slots_):
+        raise KeyError(f"policy rows missing from {manifest.cls_name} "
+                       "manifest")
+    group = slots_[0].group
+    if any(l.group != group for l in slots_):
+        raise ValueError("policy row leaves split across groups")
+    gidx = manifest.group_names().index(group)
+    offs = tuple(l.offset for l in slots_)
+    n_slots = slots_[0].shape[1]
+
+    def write(buf, slots, kid, kmeta, kval):
+        col = jnp.arange(n_slots, dtype=jnp.int32)[None, :]
+        base = slots[:, None].astype(jnp.int32) * n_slots + col
+        idx = jnp.concatenate([(o + base).reshape(-1) for o in offs])
+        vals = jnp.concatenate([kid.reshape(-1), kmeta.reshape(-1),
+                                kval.reshape(-1)])
+        return buf.at[idx].set(vals)
+
+    return jax.jit(write), gidx
+
+
+def write_leaf(manifest: PackManifest, bufs: Tuple[jnp.ndarray, ...],
+               path: str, arr) -> Optional[Tuple[jnp.ndarray, ...]]:
+    """Write one whole leaf's region into its group buffer (eager,
+    control-plane).  Returns the new buffer tuple, or None when the
+    leaf is absent from the manifest or its shape changed — the caller
+    must rebuild (geometry change re-packs and re-jits)."""
+    leaf = manifest.leaf(path)
+    if leaf is None or tuple(arr.shape) != leaf.shape:
+        return None
+    gidx = manifest.group_names().index(leaf.group)
+    out = list(bufs)
+    out[gidx] = out[gidx].at[leaf.offset:leaf.offset + leaf.size].set(
+        arr.reshape(-1))
+    return tuple(out)

@@ -678,6 +678,19 @@ class ShardedDatapath:
         own devices (no cross-shard pause)."""
         return sum(sh.gc(now) for sh in self.shards)
 
+    def pack_stats(self) -> Dict:
+        """Packed-dispatch accounting across the mesh: each shard's
+        column submesh dispatches its own grouped buffer slices
+        (parallel/packing.py), so repacks and delta write-throughs are
+        per-shard events with a per-shard blast radius."""
+        per = {str(k): sh.pack_stats()
+               for k, sh in enumerate(self.shards)}
+        return {"full-packs": sum(p["full-packs"] for p in per.values()),
+                "row-writes": sum(p["row-writes"] for p in per.values()),
+                "leaf-writes": sum(p["leaf-writes"]
+                                   for p in per.values()),
+                "per-shard": per}
+
     def flush_telemetry(self) -> None:
         for sh in self.shards:
             sh.flush_telemetry()

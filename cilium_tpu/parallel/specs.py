@@ -107,6 +107,23 @@ COUNTERS_SPECS: Dict[str, P] = {
     "packets": SHARD_LOCAL, "bytes": SHARD_LOCAL,
 }
 
+# ---------------------------------------------------------------------------
+# Packed dispatch-buffer groups (parallel/packing.py): the grouped flat
+# buffers the jitted steps actually take.  Each group's spec is the
+# distribution of the CONCATENATED buffer over the mesh — ep-grouped
+# slices belong to one shard's column, replicated groups are copied per
+# shard, and the mutable state packs are shard-local like the leaves
+# they stack.  The sharding lint asserts every group a live engine
+# builds is declared here.
+# ---------------------------------------------------------------------------
+
+PACKED_GROUP_SPECS: Dict[str, P] = {
+    "ep-int32": P(EP_AXIS),        # stacked policy rows + slot identities
+    "rep-int32": P(),              # ipcache/LB/prefilter/tunnel copies
+    "ct-state": SHARD_LOCAL,       # [8, N+1] conntrack pack (donated)
+    "counters": SHARD_LOCAL,       # [2, E*S] counter pack (donated)
+}
+
 
 def _table_classes():
     from ..datapath.conntrack import CTState

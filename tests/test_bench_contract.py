@@ -57,7 +57,8 @@ def test_smoke_final_line_parses_and_fits(tmp_path):
     suite = extra["suite"]
     for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
                  "capacity", "incremental", "latency-tier",
-                 "overload", "mesh-shard", "control-churn"):
+                 "dispatch-floor", "overload", "mesh-shard",
+                 "control-churn"):
         assert name in suite, f"{name} missing from compact suite"
         assert "value" in suite[name]
         assert "vs_baseline" in suite[name]
@@ -89,6 +90,20 @@ def test_smoke_writes_full_result_file(tmp_path):
     for key in ("frame_p99_us", "mean_records_per_launch",
                 "sync_b1_p99_us"):
         assert key in co, key
+    # the dispatch-floor schema is pinned: per-batch flatten+dispatch
+    # probes (packed vs legacy), end-to-end step times, and the
+    # jitted-step leaf-count reduction
+    df = res["extra"]["suite_configs"]["dispatch-floor"]
+    assert df["unit"] == "x"
+    b256 = df["extra"]["per_batch_us"]["256"]
+    for key in ("legacy_dispatch_p50_us", "packed_dispatch_p50_us",
+                "reduction", "legacy_step_p50_us",
+                "packed_step_p50_us"):
+        assert key in b256, key
+    lc = df["extra"]["leaf_counts"]
+    for key in ("packed-step", "legacy-step", "reduction"):
+        assert key in lc, key
+    assert "reduction_floor_met" in df["extra"]
     # the overload schema is pinned: per-multiplier legs with accepted
     # percentiles + shed accounting, admission vs unbounded
     ovl = res["extra"]["suite_configs"]["overload"]

@@ -329,12 +329,15 @@ def test_recovery_rebuilds_device_tables_from_host_of_record():
         for _ in range(2):
             _submit(disp, _chunk(rng, 8, prefixes))
         assert sup.mode == "degraded"
-        # corrupt the device-resident policy stack (host-of-record,
-        # i.e. the compiled artifacts, stays intact)
+        # corrupt the device-resident policy stack — BOTH the raw
+        # tensors and the packed dispatch buffers the jitted step
+        # actually reads (host-of-record, i.e. the compiled
+        # artifacts, stays intact)
         import jax.numpy as jnp
         bad = dp._tables.datapath._replace(
             key_meta=jnp.zeros_like(dp._tables.datapath.key_meta))
         dp._tables = dp._tables._replace(datapath=bad)
+        dp._tbufs4 = tuple(jnp.zeros_like(b) for b in dp._tbufs4)
         time.sleep(0.1)
         fresh = _chunk(rng, 64, prefixes)
         t, v2, _i = _submit(disp, fresh)
@@ -365,8 +368,8 @@ def test_supervision_disabled_is_the_pre_change_path():
         assert disp_on.supervisor is not None
         packed = jnp.zeros((10, 16), jnp.int32)
         lowered = [dp._step_packed.lower(
-            dp._tables, dp.ct.state, dp.counters, packed,
-            jnp.int32(1)).as_text() for dp in (dp_off, dp_on)]
+            *dp._lower_args_packed(packed)).as_text()
+            for dp in (dp_off, dp_on)]
         assert lowered[0] == lowered[1]
         # same records, same verdicts through both lanes
         rng = np.random.default_rng(31)

@@ -137,8 +137,11 @@ def test_shard_tables_reside_on_their_own_column(plane):
         want = {d.id for d in mesh.devices[:, k]}
         tbl = eng._tables.datapath.key_id
         assert {d.id for d in tbl.sharding.device_set} == want
-        ct = eng.ct.state.k0
-        assert {d.id for d in ct.sharding.device_set} == want
+        # the packed dispatch buffers and CT pack live on the column too
+        import jax
+        for buf in eng._tbufs4 + tuple(
+                jax.tree_util.tree_leaves(eng.ct.state)):
+            assert {d.id for d in buf.sharding.device_set} == want
 
 
 # ------------------------------------------------------------ oracle parity
@@ -424,8 +427,7 @@ def test_sharded_supervision_off_is_byte_identical():
         for p in planes.values():
             eng = p.shards[k]
             lowered.append(eng._step_packed.lower(
-                eng._tables, eng.ct.state, eng.counters, packed,
-                jnp.int32(1)).as_text())
+                *eng._lower_args_packed(packed)).as_text())
         assert lowered[0] == lowered[1]
     lane_off = planes["off"].serving()
     lane_on = planes["on"].serving()

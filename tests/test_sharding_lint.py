@@ -75,3 +75,73 @@ def test_policy_tables_shard_endpoint_axis():
         assert spec == specs.SHARD_LOCAL, leaf
     for leaf, spec in specs.FLOW_STATE_SPECS.items():
         assert spec == specs.SHARD_LOCAL, leaf
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-floor lint: the jitted step's flattened argument leaf count
+# is pinned so new leaves can't silently regrow the per-batch host
+# marshalling cost, and every packed-buffer group carries a declared
+# PartitionSpec like the raw leaves it concatenates.
+# ---------------------------------------------------------------------------
+
+# the serving hot step's leaf budget: 2 grouped table buffers + the
+# 3-buffer CT pack (split along XLA's copy-insertion boundaries — see
+# conntrack.CTPack) + the counter pack + the [10, B] packed batch +
+# the timestamp.  Raising this ceiling is a deliberate, reviewed act —
+# each extra leaf is per-batch host dispatch work on every backend and
+# every shard.
+PACKED_STEP_LEAF_CEILING = 8
+# flow aggregation adds the (deliberately unpacked, non-donated)
+# 4-leaf FlowState
+PACKED_STEP_WITH_FLOWS_CEILING = 12
+# v6 keeps the per-field packet batch (10 leaves) over the same
+# grouped tables/state
+V6_STEP_LEAF_CEILING = 17
+
+
+def _loaded_engine(flows: bool = False):
+    from bench import build_config1
+    from cilium_tpu.datapath.engine import Datapath
+    states, prefixes = build_config1(n_rules=10, n_endpoints=4)
+    dp = Datapath(ct_slots=1 << 8)
+    dp.telemetry_enabled = False
+    if flows:
+        dp.enable_flow_aggregation(slots=1 << 7)
+        dp.enable_provenance()
+    dp.load_policy(states, revision=1, ipcache_prefixes=prefixes)
+    return dp
+
+
+def test_jitted_step_leaf_ceiling():
+    dp = _loaded_engine()
+    counts = dp.dispatch_leaf_counts()
+    assert counts["packed-step"] <= PACKED_STEP_LEAF_CEILING, counts
+    # the acceptance floor: >= 4x fewer leaves than the legacy pytree
+    assert counts["legacy-step"] >= 4 * counts["packed-step"], counts
+    # the v6 step shares the grouped tables/state (only the per-field
+    # packet batch stays unpacked)
+    assert counts["v6-step"] <= V6_STEP_LEAF_CEILING, counts
+
+
+def test_jitted_step_leaf_ceiling_with_flows_and_provenance():
+    dp = _loaded_engine(flows=True)
+    counts = dp.dispatch_leaf_counts()
+    assert counts["packed-step"] <= PACKED_STEP_WITH_FLOWS_CEILING, \
+        counts
+    # FlowState rides along unpacked (4 leaves, deliberately
+    # non-donated), so the flows variant's floor is 3x, not 4x
+    assert counts["legacy-step"] >= 3 * counts["packed-step"], counts
+
+
+def test_every_packed_group_has_a_declared_spec():
+    from cilium_tpu.parallel import packing
+    dp = _loaded_engine()
+    groups = (set(dp._manifest4.group_names())
+              | set(dp._manifest6.group_names())
+              | {packing.CT_STATE_GROUP, packing.COUNTERS_GROUP})
+    undeclared = groups - set(specs.PACKED_GROUP_SPECS)
+    assert not undeclared, (
+        "packed dispatch-buffer groups without a declared "
+        f"PartitionSpec in specs.PACKED_GROUP_SPECS: {undeclared}")
+    for name, spec in specs.PACKED_GROUP_SPECS.items():
+        assert isinstance(spec, PartitionSpec), name

@@ -31,6 +31,10 @@ HOT_MODULES = (
     "cilium_tpu/parallel/mesh.py",
     "cilium_tpu/parallel/specs.py",
     "cilium_tpu/parallel/sharded.py",
+    # the dispatch-floor packing: manifest build, group concat, and
+    # delta write-through all sit under the engine lock on the
+    # control->dataplane boundary — a sync here stalls every dispatch
+    "cilium_tpu/parallel/packing.py",
 )
 
 # the engine is hot only in its dispatch functions — table loading,
