@@ -160,6 +160,14 @@ def _smoke_result():
                       "fail_static_records": 3072,
                       "healthy_shards_stayed_closed": True,
                       "frame_records": 1024},
+                  "federated_flows": {
+                      "flows_only_verdicts_per_sec": 180_000,
+                      "federated_verdicts_per_sec": 172_000,
+                      "overhead_vs_flows_only": 0.044,
+                      "gate_overhead_le_10pct": True,
+                      "drains": 120, "federated_queries": 120,
+                      "drained_flows": 4096,
+                      "flow_table_slots": 4096, "shards": 4},
                   "at_full_capacity": True}}
     # the control-churn config's pinned output schema: three legs
     # (healthy / outage / reconnect) with journal depth, reconcile

@@ -1516,6 +1516,113 @@ def bench_mesh_shard(on_accel: bool, full_capacity: bool = False):
         "frame_records": frame,
     }
     lane.close()
+    del plane, lane
+
+    # ---- federated-flows leg: flows-fused sharded serving with the
+    # federation tier (hubble/federation.py) draining every shard's
+    # device flow table + serving merged relay queries CONCURRENTLY.
+    # Gate: the complete observability plane costs <= 10% vs the
+    # flows-only leg — observing the mesh must not meaningfully slow
+    # serving it.
+    import threading
+
+    from cilium_tpu.hubble.federation import ShardedObserver
+    from cilium_tpu.hubble.filter import FlowFilter
+    from cilium_tpu.hubble.relay import HubbleRelay
+
+    flow_slots = 1 << 12
+    plane_f = ShardedDatapath(mesh=mesh, ct_slots=1 << 14)
+    plane_f.telemetry_enabled = False
+    plane_f.configure_supervision(enabled=True)
+    plane_f.enable_flow_aggregation(slots=flow_slots)
+    plane_f.load_policy(states, revision=1,
+                        ipcache_prefixes=cfg_prefixes)
+    lane_f = plane_f.serving()
+    rows = frame // n_ep
+    while rows <= (frame // n_ep) * 8:
+        for sh_eng in plane_f.shards:
+            # the flows-fused engine alternates the claiming and the
+            # statically claim-free step variants (claim_every
+            # admission striping): warm BOTH at every geometry or the
+            # flows-only measurement times the compiler
+            for _ in range(6):
+                v, _e, _i, _n = sh_eng.process_packed(
+                    np.zeros((10, rows), np.int32))
+            jax.block_until_ready(v)
+        rows *= 2
+
+    def run_frames_f(n_frames=0, horizon_s=0.0):
+        """Drive the lane for ``n_frames`` or (when ``horizon_s``)
+        until the wall-clock horizon passes — the federation legs
+        need windows long enough to amortize several drain/query
+        ticks, not a 50ms burst one drain can dominate by accident."""
+        tickets = deque()
+        done = 0
+        t0 = _time.perf_counter()
+        i = 0
+        while True:
+            if horizon_s:
+                if _time.perf_counter() - t0 >= horizon_s and \
+                        i >= (n_frames or 1):
+                    break
+            elif i >= n_frames:
+                break
+            tickets.append(lane_f.submit_records(pool[i % 16], frame))
+            i += 1
+            if len(tickets) > 4:
+                tickets.popleft().result(timeout=600)
+                done += 1
+        while tickets:
+            tickets.popleft().result(timeout=600)
+            done += 1
+        return done * frame / (_time.perf_counter() - t0)
+
+    run_frames_f(8)  # compile + settle the flows-fused programs
+    leg_horizon = 5.0
+    flows_only_vps = run_frames_f(n_frames=12, horizon_s=leg_horizon)
+
+    obs = ShardedObserver(node="bench", datapath=plane_f,
+                          capacity=8192)
+    relay = HubbleRelay(
+        local_name="bench",
+        local_fetch=lambda query, since, limit: obs.local_answer(
+            FlowFilter.from_query(query), since=since, limit=limit))
+    stop = threading.Event()
+    churn_stats = {"drains": 0, "queries": 0, "drained": 0}
+
+    def churn():
+        # the federation plane at its production cadence (the
+        # daemon's hubble-shard-drain controller defaults to
+        # hubble_drain_interval_s=1.0): bounded per-shard drains +
+        # merged relay queries while serving runs
+        while not stop.is_set():
+            churn_stats["drained"] += obs.drain(
+                max_entries=256)["drained"]
+            churn_stats["drains"] += 1
+            relay.get_flows(limit=256)
+            churn_stats["queries"] += 1
+            _time.sleep(1.0)
+
+    th = threading.Thread(target=churn, daemon=True,
+                          name="bench-federation")
+    th.start()
+    run_frames_f(2)  # settle with the drain running
+    federated_vps = run_frames_f(n_frames=12, horizon_s=leg_horizon)
+    stop.set()
+    th.join(timeout=10)
+    lane_f.close()
+    overhead = 1.0 - federated_vps / flows_only_vps
+    federated_flows = {
+        "flows_only_verdicts_per_sec": round(flows_only_vps),
+        "federated_verdicts_per_sec": round(federated_vps),
+        "overhead_vs_flows_only": round(overhead, 4),
+        "gate_overhead_le_10pct": bool(overhead <= 0.10),
+        "drains": churn_stats["drains"],
+        "federated_queries": churn_stats["queries"],
+        "drained_flows": churn_stats["drained"],
+        "flow_table_slots": flow_slots,
+        "shards": n_ep,
+    }
 
     return _result(
         "mesh_shard_verdicts_per_sec", per_mesh_vps, "verdicts/s",
@@ -1523,6 +1630,7 @@ def bench_mesh_shard(on_accel: bool, full_capacity: bool = False):
         {"mesh": {"devices": n_dev, "dp": dp_sz, "ep": n_ep},
          "capacity": capacity,
          "degraded": degraded,
+         "federated_flows": federated_flows,
          "at_full_capacity": bool(full)})
 
 

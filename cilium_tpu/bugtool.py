@@ -15,6 +15,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from .observability import jit_telemetry
+from .observability.slo import slo_tracker
 
 
 def _collectors(daemon) -> Dict[str, Callable[[], object]]:
@@ -58,6 +59,12 @@ def _collectors(daemon) -> Dict[str, Callable[[], object]]:
             "drift-audit": daemon.drift_report(),
             "top-dropped-rules": daemon.monitor.top_dropped_rules(20),
             "last-replay": daemon.last_replay_report()},
+        # the incident flight recorder: the ordered degraded-condition
+        # timeline — "what happened, when, on which shard" — plus the
+        # serving SLO tier's latency/burn snapshot
+        "flight-recorder.json": lambda: daemon.flight_events(
+            limit=500),
+        "slo.json": slo_tracker.snapshot,
     }
     if getattr(daemon, "hubble", None) is not None:
         # flow observability state (hubble/): the recent flow ring, the
@@ -90,6 +97,8 @@ def _remote_collectors(client) -> Dict[str, Callable[[], object]]:
         lambda: client.get("/flows/stats?aggregated=true"),
         "traces.json": lambda: client.get("/debug/traces"),
         "pipeline.json": lambda: client.get("/debug/pipeline"),
+        "flight-recorder.json":
+        lambda: client.get("/debug/events?n=500"),
         "provenance.json":
         lambda: (client.get("/healthz") or {}).get("provenance"),
     }

@@ -216,6 +216,35 @@ def cmd_status(c: Client, args) -> int:
         for rec in prov.get("top-dropped-rules") or []:
             print(f"TopDropped:    {rec['rule']} "
                   f"({rec['packets']} packets)")
+        # serving SLO tier: the cilium-tpu-top-style one-shot snapshot
+        # (per-lane latency percentiles, deadline-budget burn, queue
+        # flight sample) — observability/slo.py
+        slo = st.get("slo") or {}
+        lanes = slo.get("lanes") or {}
+        if lanes:
+            print(f"SLO:           objective "
+                  f"{slo.get('objective-ms', 0)}ms, error budget "
+                  f"{slo.get('error-budget', 0)}")
+            print(f"SLO:           {'LANE':<14} {'SHARD':>5} "
+                  f"{'REQS':>9} {'P50us':>9} {'P99us':>9} "
+                  f"{'BREACH':>7} {'BURN':>7} {'QUEUE':>7} "
+                  f"{'INFL':>5}")
+            for name, row in sorted(lanes.items()):
+                q = row.get("queue") or {}
+                shard = "-" if row.get("shard") is None \
+                    else str(row["shard"])
+                print(f"SLO:           {name:<14} {shard:>5} "
+                      f"{row['requests']:>9} {row['p50-us']:>9.1f} "
+                      f"{row['p99-us']:>9.1f} {row['breaches']:>7} "
+                      f"{row['burn-rate']:>7.2f} "
+                      f"{q.get('pending', 0):>7} "
+                      f"{q.get('inflight', 0):>5}")
+        fr = st.get("flight-recorder") or {}
+        if fr.get("ringed"):
+            print(f"FlightRec:     {fr['ringed']} event(s) buffered "
+                  f"(seq {fr['seq']}, {fr.get('evicted', 0)} "
+                  f"evicted) — `cilium-tpu events` replays the "
+                  f"timeline")
     return 0
 
 
@@ -500,7 +529,7 @@ def cmd_hubble(c: Client, args) -> int:
         if v:
             params.append((key, v))
     for key in ("identity", "src_identity", "dst_identity", "endpoint",
-                "dport", "l7_status"):
+                "dport", "l7_status", "shard"):
         v = getattr(args, key, None)
         if v is not None:
             params.append((key, str(v)))
@@ -532,11 +561,67 @@ def cmd_hubble(c: Client, args) -> int:
             if args.federated and out.get("partial"):
                 degraded = [n["name"] for n in out.get("nodes", [])
                             if n["status"] != "ok"]
+                # sharded peers: a degraded dataplane shard is flagged
+                # fail-open per shard (its FAIL-STATIC flows are still
+                # in the answer, marked as such)
+                for n_ in out.get("nodes", []):
+                    for s in n_.get("shards") or []:
+                        if s.get("status") != "ok":
+                            degraded.append(
+                                f"{n_['name']}/shard{s['shard']}"
+                                f"({s['status']})")
                 print(f"(partial result: {', '.join(degraded)} "
-                      "unavailable)", file=sys.stderr)
+                      "unavailable or degraded)", file=sys.stderr)
             if not args.follow:
                 return 0
             time.sleep(args.interval if not flows else 0)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_events(c: Client, args) -> int:
+    """``cilium-tpu events`` — replay the incident flight recorder's
+    ordered degraded-condition timeline (GET /debug/events), cursor-
+    paginated like ``monitor``/``hubble observe``."""
+    from urllib.parse import urlencode
+    cursor = args.since
+    try:
+        while True:
+            params = [("since", str(cursor)), ("n", str(args.n))]
+            if args.type:
+                params.append(("type", args.type))
+            if args.shard is not None:
+                params.append(("shard", str(args.shard)))
+            out = c.get("/debug/events?" + urlencode(params))
+            events = out.get("events", [])
+            for e in events:
+                cursor = max(cursor, e.get("seq", 0))
+                if args.json:
+                    print(json.dumps(e, sort_keys=True))
+                    continue
+                ts = time.strftime(
+                    "%H:%M:%S", time.localtime(e.get("timestamp", 0)))
+                where = f"[shard {e['shard']}] " \
+                    if e.get("shard") is not None else ""
+                attrs = " ".join(
+                    f"{k}={v}" for k, v in
+                    sorted((e.get("attrs") or {}).items()))
+                line = f"#{e['seq']} {ts} {where}{e['type']}"
+                if e.get("detail"):
+                    line += f": {e['detail']}"
+                if attrs:
+                    line += f" ({attrs})"
+                if e.get("trace-id"):
+                    line += f" trace={e['trace-id']}"
+                print(line)
+            if not args.follow:
+                if not events and not args.json:
+                    stats = out.get("stats") or {}
+                    print(f"(no events after seq {args.since}; "
+                          f"{stats.get('ringed', 0)} buffered, "
+                          f"{stats.get('evicted', 0)} evicted)")
+                return 0
+            time.sleep(args.interval if not events else 0)
     except KeyboardInterrupt:
         return 0
 
@@ -946,10 +1031,15 @@ def build_parser() -> argparse.ArgumentParser:
     ob.add_argument("--interval", type=float, default=1.0)
     ob.add_argument("--federated", action="store_true",
                     help="fan out to every relay peer "
-                         "(partial results flagged per node)")
+                         "(partial results flagged per node AND per "
+                         "local dataplane shard)")
+    ob.add_argument("--shard", type=int, default=None,
+                    help="sharded daemons: only this dataplane "
+                         "shard's flows")
     ob.add_argument("--json", action="store_true")
     hs = hb_sub.add_parser("stats",
-                           help="observer/aggregation/relay health")
+                           help="observer/aggregation/relay health "
+                                "(mesh-wide on sharded daemons)")
     hs.add_argument("--aggregated", action="store_true",
                     help="include the on-device per-flow counters")
 
@@ -957,6 +1047,22 @@ def build_parser() -> argparse.ArgumentParser:
     cfgp.add_argument("options", nargs="*", help="Option=value")
 
     sub.add_parser("metrics", help="Prometheus metrics dump")
+
+    ev = sub.add_parser("events",
+                        help="incident flight recorder: the ordered "
+                             "degraded-condition timeline "
+                             "(/debug/events)")
+    ev.add_argument("--since", type=int, default=0,
+                    help="resume from this sequence cursor")
+    ev.add_argument("--type", default="",
+                    help="one event type only (e.g. "
+                         "dataplane-degraded, kvstore-recovered)")
+    ev.add_argument("--shard", type=int, default=None,
+                    help="one dataplane shard's events only")
+    ev.add_argument("-n", type=int, default=200)
+    ev.add_argument("-f", "--follow", action="store_true")
+    ev.add_argument("--interval", type=float, default=1.0)
+    ev.add_argument("--json", action="store_true")
 
     trp = sub.add_parser("trace",
                          help="control-plane span traces "
@@ -1047,7 +1153,7 @@ COMMANDS = {
     "prefilter": cmd_prefilter, "monitor": cmd_monitor,
     "hubble": cmd_hubble,
     "config": cmd_config, "metrics": cmd_metrics,
-    "trace": cmd_trace,
+    "trace": cmd_trace, "events": cmd_events,
     "bugtool": cmd_bugtool, "cni": cmd_cni,
     "docker-plugin": cmd_docker_plugin,
     "debuginfo": cmd_debuginfo, "kvstore": cmd_kvstore,

@@ -46,7 +46,8 @@ from ..labels import Labels
 from ..monitor import MonitorHub
 from ..node import Node, NodeManager, NodeRegistry
 from ..observability import (PolicyPropagationTracker, jit_telemetry,
-                             pipeline_report, tracer)
+                             pipeline_report, slo_tracker, tracer)
+from ..observability.events import recorder as flight_recorder
 from ..policy.api import Rule
 from ..policy.mapstate import PolicyMapState
 from ..policy.repository import Repository
@@ -103,6 +104,12 @@ class Daemon:
         tracer.configure(enabled=self.config.enable_tracing,
                          capacity=self.config.trace_capacity)
         self.tracer = tracer
+        # serving SLO tier defaults (observability/slo.py): lanes with
+        # an admission deadline use it as their objective; everything
+        # else is judged against this one
+        slo_tracker.configure(
+            objective_s=self.config.serving_slo_objective_s,
+            error_budget=self.config.serving_slo_error_budget)
         self.propagation = PolicyPropagationTracker(tracer=tracer)
         self.datapath.telemetry_enabled = self.config.enable_tracing
         self.datapath.on_revision_served = \
@@ -177,17 +184,38 @@ class Daemon:
                 self.datapath.enable_flow_aggregation(
                     slots=self.config.hubble_flow_slots,
                     max_probe=self.config.hubble_flow_probe)
-            self.hubble = FlowObserver(
-                node=node_name,
-                capacity=self.config.hubble_ring_capacity,
-                datapath=self.datapath)
+            if self.config.dataplane_shards >= 2:
+                # the federated cross-shard observer (hubble/
+                # federation.py): per-shard flow stores behind one
+                # cursor, per-shard device-table drains, and merged
+                # shard-attributed answers with fail-open flags
+                from ..hubble.federation import ShardedObserver
+                self.hubble = ShardedObserver(
+                    node=node_name, datapath=self.datapath,
+                    capacity=self.config.hubble_ring_capacity)
+                if self.config.hubble_drain_interval_s > 0:
+                    self.controllers.update_controller(
+                        "hubble-shard-drain", ControllerParams(
+                            do_func=lambda: self.hubble.drain(),
+                            run_interval=self.config
+                            .hubble_drain_interval_s))
+            else:
+                self.hubble = FlowObserver(
+                    node=node_name,
+                    capacity=self.config.hubble_ring_capacity,
+                    datapath=self.datapath)
             self.hubble.attach_monitor(self.monitor)
             self.hubble.attach_access_log(self.proxy.access_log)
 
             def _local_fetch(query, since, limit):
+                flt = FlowFilter.from_query(query)
+                if hasattr(self.hubble, "local_answer"):
+                    # sharded: the answer carries per-shard fail-open
+                    # statuses the relay propagates mesh-wide
+                    return self.hubble.local_answer(
+                        flt, since=since, limit=limit)
                 return {"flows": self.hubble.get_flows(
-                    FlowFilter.from_query(query), since=since,
-                    limit=limit)}
+                    flt, since=since, limit=limit)}
 
             self.hubble_relay = HubbleRelay(
                 local_name=node_name, local_fetch=_local_fetch,
@@ -960,7 +988,21 @@ class Daemon:
         report["sc-checked"] = report.pop("sc_checked")
         report["duration-s"] = report.pop("duration_s")
         with self._lock:
+            prev = (self._drift_report or {}).get("status")
             self._drift_report = report
+        # flight recorder: every FAILING sweep is an incident event
+        # (the compiler-correctness verdict), plus the all-clear
+        # transition when a failing audit goes green again
+        if report["status"] == "FAILING" or \
+                (prev == "FAILING" and report["status"] == "ok"):
+            from ..observability.events import (EVENT_DRIFT_AUDIT,
+                                                recorder)
+            recorder.record(
+                EVENT_DRIFT_AUDIT, status=report["status"],
+                divergences=len(report["divergences"]),
+                checked=report["checked"],
+                detail=str(report["divergences"][:1])
+                if report["divergences"] else "audit back to ok")
         return report
 
     def _dataplane_recovery_gate(self) -> bool:
@@ -980,6 +1022,21 @@ class Daemon:
     def last_replay_report(self) -> Optional[Dict]:
         with self._lock:
             return self._last_replay
+
+    # ------------------------------------- incident flight recorder
+
+    def flight_events(self, since: int = 0, limit: int = 200,
+                      event_type: Optional[str] = None,
+                      shard: Optional[int] = None) -> Dict:
+        """GET /debug/events / ``cilium-tpu events``: the ordered
+        incident timeline — every degraded-condition transition the
+        agent recorded, cursor-paginated like the monitor ring."""
+        from ..observability.events import recorder
+        return {"events": [e.to_dict() for e in
+                           recorder.events(since, limit, event_type,
+                                           shard)],
+                "seq": recorder.last_seq,
+                "stats": recorder.stats()}
 
     # -------------------------------------------------- regeneration
 
@@ -1456,6 +1513,15 @@ class Daemon:
                 "tracing": self.tracer.stats(),
                 "jit": jit_telemetry.report(),
                 "propagation": self.propagation.report(5)},
+            # serving SLO tier (observability/slo.py): per-lane
+            # latency percentiles, deadline-budget burn rates and the
+            # latest queue-flight sample — `status --verbose` renders
+            # the cilium-tpu-top-style table from this block
+            "slo": slo_tracker.snapshot(),
+            # incident flight recorder health: how much of the ordered
+            # degraded-condition timeline is buffered for
+            # `cilium-tpu events` / GET /debug/events
+            "flight-recorder": flight_recorder.stats(),
             # flow observability health (hubble observer + relay)
             "hubble": self.hubble.stats()
             if self.hubble is not None else None,

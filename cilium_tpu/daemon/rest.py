@@ -147,6 +147,17 @@ class _Handler(BaseHTTPRequestHandler):
                 # host-timed stage slices + blocking boundaries
                 # (observability/stages.py pipeline_report)
                 return self._send(200, d.pipeline_report())
+            if path == "/debug/events" and method == "GET":
+                # the incident flight recorder (observability/
+                # events.py): ordered degraded-condition transitions,
+                # cursor-paginated via ?since=<seq> like /monitor
+                shard_q = qs.get("shard", [None])[0]
+                return self._send(200, d.flight_events(
+                    since=int(qs.get("since", ["0"])[0]),
+                    limit=int(qs.get("n", ["200"])[0]),
+                    event_type=qs.get("type", [None])[0],
+                    shard=int(shard_q) if shard_q is not None
+                    else None))
             if path == "/debug/drift-audit" and method == "POST":
                 # on-demand drift-audit sweep (the periodic
                 # controller's body): replay sampled tuples through
@@ -191,6 +202,11 @@ class _Handler(BaseHTTPRequestHandler):
                         "pipeline": d.pipeline_report(),
                         "map-pressure": d.datapath.map_pressure(
                             d.config.map_pressure_warn)},
+                    # the incident flight recorder: the ordered
+                    # degraded-condition timeline + the serving SLO
+                    # snapshot — "what happened, in order, and was
+                    # the latency objective held"
+                    "events": d.flight_events(limit=200),
                     # verdict provenance: drift-audit verdict on the
                     # compiler, the heaviest denied keys, and the
                     # last replay report — "was this verdict right"
@@ -438,7 +454,8 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/flows" and method == "GET":
                 # Hubble observer surface (observer GetFlows analog):
                 # filter grammar in the query string, cursor paging
-                # via since=<seq>, federation via federated=true
+                # via since=<seq>, federation via federated=true,
+                # one dataplane shard via shard=<k> (sharded daemons)
                 from ..hubble.filter import FlowFilter
                 flt = FlowFilter.from_query(qs)
                 n = int(qs.get("n", ["100"])[0])
@@ -449,9 +466,21 @@ class _Handler(BaseHTTPRequestHandler):
                         flt, limit=n))
                 if d.hubble is None:
                     return self._error(503, "hubble disabled")
+                shard_q = qs.get("shard", [None])[0]
+                if hasattr(d.hubble, "local_answer"):
+                    # sharded: merged shard-attributed flows plus the
+                    # per-shard fail-open statuses
+                    return self._send(200, d.hubble.local_answer(
+                        flt, limit=n,
+                        shard=int(shard_q) if shard_q is not None
+                        else None))
+                if shard_q is not None:
+                    return self._error(
+                        400, "shard= requires a sharded dataplane "
+                             "(dataplane_shards >= 2)")
                 return self._send(200, {
                     "flows": d.hubble.get_flows(flt, limit=n),
-                    "seq": d.hubble.store.last_seq,
+                    "seq": d.hubble.last_seq,
                     "node": d.hubble.node})
             if path == "/flows/stats" and method == "GET":
                 if d.hubble is None:

@@ -66,6 +66,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability.events import (EVENT_SERVING_OVERLOAD,
+                                    recorder as flight_recorder)
+from ..observability.slo import slo_tracker
 from ..observability.stages import record_stage
 from ..utils.bucketing import bucket_size
 from ..utils.metrics import DATAPLANE_OVERLOADED, registry
@@ -211,6 +214,11 @@ class ContinuousDispatcher:
         # ---- device-fault supervision (datapath/supervisor.py):
         # classify faults, fail static from the host oracle, recover
         self.supervisor = supervisor
+        # serving SLO tier (observability/slo.py): resolved tickets
+        # observe submit->finalize latency against the lane objective
+        # (the admission deadline when one is set); launches sample
+        # queue depth into the flight ring
+        self._shard = getattr(supervisor, "shard", None)
         # observability: how well the batching is working
         self.batches = 0
         self.frames = 0
@@ -238,6 +246,11 @@ class ContinuousDispatcher:
             self.overloaded = value
             DATAPLANE_OVERLOADED.set(1.0 if value else 0.0,
                                      labels={"lane": self.lane})
+            # watermark crossings are incident-timeline transitions
+            flight_recorder.record(
+                EVENT_SERVING_OVERLOAD, shard=self._shard,
+                lane=self.lane, state="on" if value else "off",
+                pending=self._pending_weight)
 
     def submit(self, item, deadline: Optional[float] = None) -> Ticket:
         """Queue one item from any thread; returns its Ticket.
@@ -361,6 +374,12 @@ class ContinuousDispatcher:
                          t0 - batch[0][1].submitted_at)
             record_stage(self.family, "dispatch",
                          time.perf_counter() - t0)
+        # SLO flight sample: queue state as of this launch (racy reads
+        # are fine — observability, not control flow)
+        slo_tracker.sample_queue(self.lane, queued=len(self._pending),
+                                 inflight=len(self._inflight),
+                                 pending_weight=self._pending_weight,
+                                 shard=self._shard)
         self._inflight.append(
             (handle, batch, [self._weight(item) for item, _t in batch]))
         self.batches += 1
@@ -395,11 +414,24 @@ class ContinuousDispatcher:
                          time.perf_counter() - t0)
         for (item, ticket), res in zip(batch, results):
             ticket.resolve(res)
+        self._observe_slo(batch)
+
+    def _observe_slo(self, batch) -> None:
+        """Feed resolved tickets into the serving SLO tier: one
+        submit->finalize latency observation per frame, judged against
+        the lane's objective (its admission deadline when set)."""
+        now = time.perf_counter()
+        for _item, ticket in batch:
+            slo_tracker.observe(self.lane,
+                                now - ticket.submitted_at,
+                                shard=self._shard,
+                                objective_s=self.default_deadline)
 
     def _fail(self, batch, error: BaseException) -> None:
         self.errors += 1
         for item, ticket in batch:
             ticket.resolve(self._deny(item), error)
+        self._observe_slo(batch)
 
     def _resolve_static(self, batch, payload) -> None:
         """Resolve one batch with the supervisor's fail-static answer
@@ -415,6 +447,7 @@ class ContinuousDispatcher:
         self.frames += len(batch)
         for (item, ticket), res in zip(batch, results):
             ticket.resolve(res)
+        self._observe_slo(batch)
 
     # ---------------------------------------------------------- lifecycle
 

@@ -49,6 +49,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observability.events import (EVENT_DATAPLANE_DEGRADED,
+                                    EVENT_DATAPLANE_FAIL_STATIC,
+                                    EVENT_DATAPLANE_REBUILD,
+                                    EVENT_DATAPLANE_RECOVERED,
+                                    EVENT_DATAPLANE_TRIP,
+                                    recorder as flight_recorder)
 from ..utils.faultinject import DeviceLaneFault
 from ..utils.metrics import (DATAPLANE_DEVICE_FAULTS,
                              DATAPLANE_FAIL_STATIC, DATAPLANE_MODE,
@@ -355,6 +361,10 @@ class DeviceSupervisor:
         self.faults: Dict[str, int] = {}
         self.recoveries = 0
         self.last_fault: Optional[str] = None
+        # flight recorder: the first fail-static batch of each
+        # degradation window is an event; subsequent batches are the
+        # steady degraded state, not transitions
+        self._static_reported = False
 
     # ----------------------------------------------------------- chaos
 
@@ -390,8 +400,22 @@ class DeviceSupervisor:
     def _sync_mode(self) -> None:
         mode = self.mode
         if mode != self._mode:
-            self._mode = mode
+            prev, self._mode = self._mode, mode
             self._set_mode_gauge(float(_MODE_CODE[mode]))
+            # flight recorder: mode flips ARE the incident timeline's
+            # spine (trip -> degraded -> fail-static -> rebuild ->
+            # recovered)
+            if mode == MODE_DEGRADED:
+                flight_recorder.record(
+                    EVENT_DATAPLANE_DEGRADED,
+                    detail=self.last_fault or "", shard=self.shard,
+                    breaker=self.breaker.state)
+            elif mode == MODE_OK and prev != MODE_OK:
+                flight_recorder.record(
+                    EVENT_DATAPLANE_RECOVERED, shard=self.shard,
+                    recoveries=self.recoveries,
+                    fail_static_records=self.fail_static_records)
+                self._static_reported = False
 
     # --------------------------------------------------------- dispatch
 
@@ -461,6 +485,10 @@ class DeviceSupervisor:
         kind = kind or classify_fault(e)
         self.faults[kind] = self.faults.get(kind, 0) + 1
         self.last_fault = f"{stage}: {e!r}"
+        flight_recorder.record(EVENT_DATAPLANE_TRIP,
+                               detail=self.last_fault,
+                               shard=self.shard, stage=stage,
+                               kind=kind)
         DATAPLANE_DEVICE_FAULTS.inc(labels={"stage": stage,
                                             "kind": kind})
         if self.shard is not None:
@@ -530,6 +558,13 @@ class DeviceSupervisor:
         self.fail_static_batches += 1
         self.fail_static_records += total
         DATAPLANE_FAIL_STATIC.inc(total)
+        if not self._static_reported:
+            # first fail-static batch of this degradation window
+            self._static_reported = True
+            flight_recorder.record(EVENT_DATAPLANE_FAIL_STATIC,
+                                   shard=self.shard, records=total,
+                                   new_flow_policy=self.oracle
+                                   .new_flow_policy)
         return results, None
 
     # --------------------------------------------------------- recovery
@@ -548,13 +583,26 @@ class DeviceSupervisor:
                 dp.reload_services()  # full _rebuild from compiled
         except Exception as e:  # noqa: BLE001 — rebuild failed: the
             self.last_fault = f"recovery-rebuild: {e!r}"
+            flight_recorder.record(EVENT_DATAPLANE_REBUILD,
+                                   detail=self.last_fault,
+                                   shard=self.shard,
+                                   result="rebuild-failed")
             return False
         gate = self._recovery_gate or self._default_gate
         try:
-            return bool(gate())
+            ok = bool(gate())
         except Exception as e:  # noqa: BLE001 — a gate that raises is
             self.last_fault = f"recovery-gate: {e!r}"
+            flight_recorder.record(EVENT_DATAPLANE_REBUILD,
+                                   detail=self.last_fault,
+                                   shard=self.shard,
+                                   result="gate-raised")
             return False        # a gate that failed
+        flight_recorder.record(
+            EVENT_DATAPLANE_REBUILD, shard=self.shard,
+            result="ok" if ok else "gate-failed",
+            detail="" if ok else (self.last_fault or ""))
+        return ok
 
     def _default_gate(self) -> bool:
         """Self-contained drift replay: sample installed keys from the

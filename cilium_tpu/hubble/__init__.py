@@ -12,8 +12,13 @@ The subsystem spans device -> daemon -> CLI -> cluster:
     observer    — one node's queryable flow view + flow-derived metrics
     relay       — federated get_flows fan-out with per-peer deadlines
                   and circuit breakers (fail-open, flagged partials)
+    federation  — the cross-shard tier on sharded daemons: per-shard
+                  flow stores behind one shared cursor, per-shard
+                  device-table drains, and shard-attributed merged
+                  answers with fail-open degradation flags
 """
 
+from .federation import ShardedObserver
 from .aggregation import (FlowState, FlowTable, aggregate_oracle,
                           flow_update_step, make_flow_state,
                           snapshot_to_oracle_form)
@@ -29,5 +34,5 @@ __all__ = [
     "FlowFilter", "parse_drop_reason", "parse_proto", "parse_verdict",
     "FlowRecord", "FlowStore", "flow_from_access_log", "flow_from_dict",
     "flow_from_event", "verdict_of_event",
-    "FlowObserver", "HubbleRelay", "rest_peer",
+    "FlowObserver", "HubbleRelay", "rest_peer", "ShardedObserver",
 ]

@@ -56,20 +56,27 @@ _SRC, _DST, _META, _LS = 0, 1, 2, 3
 
 
 class FlowState(NamedTuple):
-    """Device flow table ([N+1] rows; the last row is the no-op
-    sentinel that absorbs masked scatters)."""
+    """Device flow table, packed to TWO dispatch leaves (the PR 12
+    packing-manifest treatment applied to the flows-enabled step,
+    which used to ride along as 4 unpacked leaves).
 
-    keys: jnp.ndarray      # [N+1, 4] int32: src, dst, meta, last_seen
+    ``keys`` carries [N+2] rows: N entry rows, the no-op sentinel at
+    row N that absorbs masked scatters, and the accounting row at
+    N+1 whose first two lanes are the cumulative (lost, updates)
+    counters that used to be their own [1] leaves — scatters only ever
+    target rows <= N, so the accounting lanes ride for free.  The
+    uint32 counters stay their own buffer: splitting along the dtype
+    boundary mirrors the CTPack lesson (a monolithic mixed pack forces
+    whole-table copies at XLA's copy-insertion boundaries)."""
+
+    keys: jnp.ndarray      # [N+2, 4] int32: src, dst, meta, last_seen;
+    #                        row N+1 = (lost, updates, 0, 0)
     counters: jnp.ndarray  # [N+1, 2] uint32: packets, bytes
-    lost: jnp.ndarray      # [1] int32 cumulative untracked rows
-    updates: jnp.ndarray   # [1] int32 cumulative rows aggregated
 
 
 def make_flow_state(slots: int) -> FlowState:
-    return FlowState(keys=jnp.zeros((slots + 1, 4), jnp.int32),
-                     counters=jnp.zeros((slots + 1, 2), jnp.uint32),
-                     lost=jnp.zeros(1, jnp.int32),
-                     updates=jnp.zeros(1, jnp.int32))
+    return FlowState(keys=jnp.zeros((slots + 2, 4), jnp.int32),
+                     counters=jnp.zeros((slots + 1, 2), jnp.uint32))
 
 
 def pack_flow_meta(dport, proto, event):
@@ -223,10 +230,12 @@ def flow_update_step(st: FlowState, src_id, dst_id, dport, proto,
         n_rows = jnp.int32(b)
     else:
         n_rows = jnp.sum(active.astype(jnp.int32))
-    return FlowState(
-        keys=keys, counters=counters,
-        lost=st.lost + (n_rows - n_tracked),
-        updates=st.updates + n_rows)
+    # accounting row (slots + 1): cumulative (lost, updates) ride the
+    # keys pack — one tiny scatter-add, no extra dispatch leaves
+    keys = keys.at[jnp.int32(slots + 1)].add(
+        jnp.stack([n_rows - n_tracked, n_rows,
+                   jnp.int32(0), jnp.int32(0)]))
+    return FlowState(keys=keys, counters=counters)
 
 
 def place_sharded(state: FlowState, mesh) -> FlowState:
@@ -272,17 +281,18 @@ class FlowTable:
 
     @property
     def lost(self) -> int:
-        return int(np.asarray(self.state.lost)[0])
+        return int(np.asarray(self.state.keys[self.slots + 1, 0]))
 
     @property
     def updates(self) -> int:
-        return int(np.asarray(self.state.updates)[0])
+        return int(np.asarray(self.state.keys[self.slots + 1, 1]))
 
     def snapshot(self, max_entries: int = 1 << 16) -> List[Dict]:
         """Decode live flows to host dicts (cilium bpf map dump analog)."""
         keys = np.asarray(self.state.keys)
         cnt = np.asarray(self.state.counters)
-        idx = np.flatnonzero(keys[:-1, _META])[:max_entries]
+        # entry rows only: row N is the sentinel, row N+1 accounting
+        idx = np.flatnonzero(keys[:self.slots, _META])[:max_entries]
         return [{
             "src-identity": int(keys[i, _SRC]),
             "dst-identity": int(keys[i, _DST]),
@@ -293,7 +303,8 @@ class FlowTable:
             "last-seen": int(keys[i, _LS])} for i in idx.tolist()]
 
     def entry_count(self) -> int:
-        return int((np.asarray(self.state.keys[:-1, _META]) != 0).sum())
+        return int((np.asarray(self.state.keys[:self.slots, _META])
+                    != 0).sum())
 
     def stats(self) -> Dict:
         occupied = self.entry_count()

@@ -12,7 +12,7 @@ deduping.
 from __future__ import annotations
 
 import threading
-from dataclasses import asdict, dataclass
+from dataclasses import dataclass
 from typing import Dict, List
 
 from ..datapath.events import DROP_NAMES, TRACE_NAMES
@@ -55,6 +55,10 @@ class FlowRecord:
     # decided — matched policymap entry, or the denied query key
     tier: str = ""
     matched_rule: str = ""
+    # owning dataplane shard on a sharded daemon (-1 = unsharded /
+    # unknown); stamped by the federated observer so a mesh-wide
+    # answer attributes every flow to its fault domain
+    shard: int = -1
     l7_protocol: str = ""    # "http" | "dns" | "kafka" | parser name
     l7_method: str = ""      # HTTP method / kafka api / dns qtype
     l7_path: str = ""        # HTTP path / kafka topic / dns name
@@ -62,7 +66,11 @@ class FlowRecord:
     summary: str = ""
 
     def to_dict(self) -> Dict:
-        return asdict(self)
+        # manual field walk: dataclasses.asdict deep-copies per field,
+        # which is measurable at federation drain rates (every ringed
+        # record passes through here on its way into the store)
+        return {f: getattr(self, f)
+                for f in self.__dataclass_fields__}
 
     def describe(self) -> str:
         if self.summary:
@@ -88,7 +96,8 @@ def flow_from_dict(d: Dict) -> FlowRecord:
     return FlowRecord(**{k: v for k, v in d.items() if k in fields})
 
 
-def flow_from_event(ev, node: str, seq: int = 0) -> FlowRecord:
+def flow_from_event(ev, node: str, seq: int = 0,
+                    shard: int = -1) -> FlowRecord:
     """Sampled datapath event (monitor.MonitorEvent, kind "") -> flow."""
     from ..datapath.events import TIER_NAMES
     tier = getattr(ev, "tier", 0)
@@ -101,10 +110,11 @@ def flow_from_event(ev, node: str, seq: int = 0) -> FlowRecord:
         drop_reason=DROP_NAMES.get(ev.code, "") if ev.code < 0 else "",
         tier=TIER_NAMES.get(tier, str(tier)) if tier else "",
         matched_rule=getattr(ev, "matched_rule", ""),
-        summary="")
+        shard=shard, summary="")
 
 
-def flow_from_access_log(entry, node: str, seq: int = 0) -> FlowRecord:
+def flow_from_access_log(entry, node: str, seq: int = 0,
+                         shard: int = -1) -> FlowRecord:
     """Proxy access-log record (proxy.AccessLogEntry) -> L7 flow."""
     info = entry.info or {}
     status = info.get("status", info.get("rcode", 0))
@@ -123,7 +133,7 @@ def flow_from_access_log(entry, node: str, seq: int = 0) -> FlowRecord:
         src_identity=entry.src_identity,
         dst_identity=entry.dst_identity,
         l7_protocol=entry.l7_protocol, l7_method=method,
-        l7_path=path, l7_status=status, summary="")
+        l7_path=path, l7_status=status, shard=shard, summary="")
 
 
 class FlowStore:
@@ -132,20 +142,25 @@ class FlowStore:
     oldest-first and accounted (``evicted``) so a reader can tell a
     quiet stream from an overrun one."""
 
-    def __init__(self, capacity: int = 8192):
+    def __init__(self, capacity: int = 8192, seq_source=None):
         self.capacity = capacity
         self._lock = threading.Lock()
         self._ring: List[FlowRecord] = []
         self._next_seq = 1
+        # optional shared cursor (hubble/federation.py): per-shard
+        # stores of one federated observer draw from ONE monotonic
+        # sequence, so a merged answer pages with a single cursor
+        self._seq_source = seq_source
         self.evicted = 0
 
     def add(self, record: FlowRecord) -> FlowRecord:
         """Assign the next sequence number and ring the record;
         returns the stamped record."""
         with self._lock:
-            stamped = FlowRecord(**{**record.to_dict(),
-                                    "seq": self._next_seq})
-            self._next_seq += 1
+            seq = self._seq_source() if self._seq_source is not None \
+                else self._next_seq
+            self._next_seq = max(self._next_seq, seq) + 1
+            stamped = FlowRecord(**{**record.to_dict(), "seq": seq})
             self._ring.append(stamped)
             if len(self._ring) > self.capacity:
                 drop = len(self._ring) - self.capacity

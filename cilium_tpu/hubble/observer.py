@@ -26,13 +26,20 @@ class FlowObserver:
     """Local observer: store + metrics + aggregation-table view."""
 
     def __init__(self, node: str = "node-local",
-                 capacity: int = 8192, datapath=None):
+                 capacity: int = 8192, datapath=None,
+                 seq_source=None):
         self.node = node
-        self.store = FlowStore(capacity=capacity)
+        self.store = FlowStore(capacity=capacity,
+                               seq_source=seq_source)
         self.datapath = datapath
         self._lock = threading.Lock()
         self._unsubs: List[Callable] = []
         self._followers: List[Callable[[FlowRecord], None]] = []
+
+    @property
+    def last_seq(self) -> int:
+        """Newest assigned flow cursor (the REST paging anchor)."""
+        return self.store.last_seq
 
     # -------------------------------------------------------- ingestion
 
@@ -105,17 +112,26 @@ class FlowObserver:
 
     def aggregate_snapshot(self, max_entries: int = 4096) -> List[Dict]:
         """The on-device flow table's per-flow counters (empty when
-        device aggregation is disabled)."""
+        device aggregation is disabled).  Goes through the engine's
+        ``flow_snapshot`` surface, which a sharded dataplane
+        aggregates across EVERY shard — ``dp.flows`` alone would be
+        shard 0's table only."""
         dp = self.datapath
         if dp is None or getattr(dp, "flows", None) is None:
             return []
+        if hasattr(dp, "flow_snapshot"):
+            return dp.flow_snapshot(max_entries)
         return dp.flows.snapshot(max_entries)
 
     def stats(self) -> Dict:
         out = {"node": self.node, "store": self.store.stats()}
         dp = self.datapath
         if dp is not None and getattr(dp, "flows", None) is not None:
-            out["aggregation"] = dp.flows.stats()
+            # mesh-wide view: ShardedDatapath.flow_stats() sums every
+            # shard's table (with a per-shard breakdown); reading
+            # dp.flows.stats() here reported only the first shard
+            out["aggregation"] = dp.flow_stats() \
+                if hasattr(dp, "flow_stats") else dp.flows.stats()
         else:
             out["aggregation"] = None
         if self.store.evicted:

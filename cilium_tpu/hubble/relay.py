@@ -153,8 +153,15 @@ class HubbleRelay:
                 HUBBLE_RELAY_SECONDS.observe(time.monotonic() - t0)
                 flows = out.get("flows", out) if isinstance(out, dict) \
                     else out
+                # sharded peers (hubble/federation.py) attach
+                # per-shard fail-open statuses to their answer; they
+                # ride the node status so a mesh-wide observe can
+                # flag exactly the degraded fault domain
+                shards = out.get("shards") \
+                    if isinstance(out, dict) else None
                 results[peer.name] = {"status": "ok",
-                                      "flows": list(flows or [])}
+                                      "flows": list(flows or []),
+                                      "shards": shards}
                 peer.breaker.record_success()
                 peer.last_ok = time.time()
             except Exception as e:  # noqa: BLE001 — per-peer fail-open
@@ -206,9 +213,16 @@ class HubbleRelay:
                                 "status": r["status"],
                                 "flows": len(got),
                                 "breaker": peer.breaker.state,
+                                **({"shards": r["shards"]}
+                                   if r.get("shards") else {}),
                                 **({"error": r["error"]}
                                    if r.get("error") else {})})
             if r["status"] != "ok":
+                partial = True
+            elif any(s.get("status") != "ok"
+                     for s in r.get("shards") or []):
+                # a degraded dataplane shard is a fail-open partial:
+                # its FAIL-STATIC flows are in the answer, flagged
                 partial = True
         flows.sort(key=lambda f: (f.get("timestamp", 0.0),
                                   f.get("node", ""), f.get("seq", 0)))

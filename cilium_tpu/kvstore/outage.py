@@ -39,6 +39,10 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..observability.events import (EVENT_KVSTORE_DEGRADED,
+                                    EVENT_KVSTORE_RECONCILING,
+                                    EVENT_KVSTORE_RECOVERED,
+                                    recorder as flight_recorder)
 from ..utils.metrics import (KVSTORE_JOURNAL_DEPTH, KVSTORE_MODE,
                              KVSTORE_RECONCILE, KVSTORE_STALENESS)
 from ..utils.resilience import CircuitBreaker
@@ -133,6 +137,13 @@ class OutageGuard(BackendOperations):
                 self._set_mode_locked(MODE_DEGRADED)
                 self._degraded_at = time.monotonic()
                 self._outages += 1
+                flight_recorder.record(
+                    EVENT_KVSTORE_DEGRADED,
+                    detail=f"{self.name}: "
+                           f"{self._consecutive_failures} consecutive "
+                           f"failures; pinning last-known-good",
+                    outage=self._outages,
+                    journal_depth=self.journal.depth())
 
     def _set_mode_locked(self, mode: str) -> None:
         self._mode = mode
@@ -395,12 +406,22 @@ class OutageGuard(BackendOperations):
         # reconnected: reconcile before announcing ok
         with self._mu:
             self._set_mode_locked(MODE_RECONCILING)
+        flight_recorder.record(
+            EVENT_KVSTORE_RECONCILING,
+            detail=f"{self.name}: reconnect detected; replaying "
+                   f"journal + relist repair",
+            journal_depth=self.journal.depth())
         ok = self._reconcile()
         if not ok:
             with self._mu:
                 self._set_mode_locked(MODE_DEGRADED)
             self._breaker.trip()
             KVSTORE_RECONCILE.inc(labels={"result": "failed"})
+            flight_recorder.record(
+                EVENT_KVSTORE_DEGRADED,
+                detail=f"{self.name}: reconcile failed mid-replay; "
+                       f"journal tail stays queued",
+                journal_depth=self.journal.depth())
             return {}
         self._breaker.record_success()
         with self._mu:
@@ -411,6 +432,11 @@ class OutageGuard(BackendOperations):
         KVSTORE_RECONCILE.inc(labels={"result": "ok"})
         KVSTORE_STALENESS.set(0.0)
         KVSTORE_JOURNAL_DEPTH.set(self.journal.depth())
+        flight_recorder.record(
+            EVENT_KVSTORE_RECOVERED, detail=self.name,
+            replayed=(report or {}).get("replayed", 0),
+            repaired=(report or {}).get("repaired", 0),
+            outage_s=(report or {}).get("outage-s", 0.0))
         return {"reconciled": True, "report": report}
 
     def _reconcile(self) -> bool:

@@ -23,6 +23,15 @@ lightweight span tracer for control-plane causality:
 - ``pressure``     — map-pressure gauges + warning thresholds for
                      every device table (pkg/metrics BPFMapPressure
                      analog).
+- ``events``       — the incident flight recorder: a bounded ring of
+                     structured degraded-condition transitions
+                     (supervisor/breaker/overload/kvstore/drift),
+                     served at /debug/events and ``cilium-tpu
+                     events``.
+- ``slo``          — the serving SLO tier: per-lane latency
+                     objectives, deadline-budget burn rates, and
+                     queue-depth flight samples
+                     (``serving_slo_*`` series).
 """
 
 from .tracer import Span, SpanContext, Tracer, tracer
@@ -31,6 +40,9 @@ from .propagation import (POLICY_IMPLEMENTATION_DELAY,
 from .jitstats import JitTelemetry, jit_telemetry
 from .stages import PIPELINE_STAGE_SECONDS, pipeline_report, record_stage
 from .pressure import MAP_PRESSURE, compute_pressure
+from .events import (DEGRADED_SIGNALS, EVENT_TYPES, FlightEvent,
+                     FlightRecorder, recorder)
+from .slo import SLOTracker, slo_tracker
 
 __all__ = [
     "Span", "SpanContext", "Tracer", "tracer",
@@ -38,4 +50,7 @@ __all__ = [
     "JitTelemetry", "jit_telemetry",
     "PIPELINE_STAGE_SECONDS", "pipeline_report", "record_stage",
     "MAP_PRESSURE", "compute_pressure",
+    "DEGRADED_SIGNALS", "EVENT_TYPES", "FlightEvent",
+    "FlightRecorder", "recorder",
+    "SLOTracker", "slo_tracker",
 ]

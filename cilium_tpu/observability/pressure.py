@@ -41,6 +41,11 @@ MAP_SHARD_ENTRIES = registry.gauge(
 
 DEFAULT_WARN_THRESHOLD = 0.9
 
+# flight-recorder edge detection: (shard, map) keys currently above
+# the warn threshold — a warning records ONE event when it appears,
+# not one per status()/metrics scrape, and re-arms when it clears
+_warned_keys: set = set()
+
 
 def _bounded(occupied: int, capacity: int) -> float:
     if capacity <= 0:
@@ -75,10 +80,20 @@ def compute_pressure(inventory: Dict[str, Dict],
                       "pressure": p}
         pressure_g.set(p, labels={"map": name, **labels})
         entries_g.set(float(occupied), labels={"map": name, **labels})
+        key = (shard, name)
         if capacity > 0 and p >= warn_threshold:
             warnings.append(
                 f"{prefix}{name}: {occupied}/{capacity} "
                 f"({p * 100:.1f}% >= {warn_threshold * 100:.0f}%)")
+            if key not in _warned_keys:
+                _warned_keys.add(key)
+                from .events import EVENT_MAP_PRESSURE, recorder
+                recorder.record(EVENT_MAP_PRESSURE,
+                                detail=warnings[-1], shard=shard,
+                                map=name, occupied=occupied,
+                                capacity=capacity)
+        else:
+            _warned_keys.discard(key)
 
     for name in ("ct", "ct6"):
         entry = inventory.get(name)

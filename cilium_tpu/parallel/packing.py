@@ -39,6 +39,10 @@ import jax.numpy as jnp
 # of the same lint-enforced group namespace)
 CT_STATE_GROUP = "ct-state"
 COUNTERS_GROUP = "counters"
+# the two-leaf Hubble flow pack (hubble/aggregation.py FlowState):
+# keys buffer carries the lost/updates accounting row, counters stay
+# their own uint32 buffer along the dtype boundary
+FLOW_STATE_GROUP = "flow-state"
 
 
 class LeafSlot(NamedTuple):

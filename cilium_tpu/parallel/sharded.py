@@ -482,6 +482,23 @@ class ShardedDatapath:
             out.extend(sh.flow_snapshot(max_entries))
         return out[:max_entries]
 
+    def shard_flow_snapshot(self, shard: int,
+                            max_entries: int = 4096):
+        """ONE shard's device flow table (the federated observer's
+        per-shard drain source — hubble/federation.py)."""
+        return self.shards[shard].flow_snapshot(max_entries)
+
+    def shard_flow_stats(self, shard: int):
+        return self.shards[shard].flow_stats()
+
+    def shard_modes(self) -> Dict[int, str]:
+        """{shard: supervisor mode} without creating serving lanes —
+        the per-shard fail-open flag source for federated flow
+        answers (a degraded shard's flows are FAIL-STATIC records and
+        must be flagged as such)."""
+        return {k: sh.supervision_status().get("mode", "ok")
+                for k, sh in enumerate(self.shards)}
+
     def flow_stats(self):
         per = [sh.flow_stats() for sh in self.shards]
         if all(p is None for p in per):

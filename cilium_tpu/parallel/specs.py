@@ -99,8 +99,10 @@ CT_STATE_SPECS: Dict[str, P] = {
 }
 
 FLOW_STATE_SPECS: Dict[str, P] = {
+    # two-leaf flow pack (hubble/aggregation.py FlowState): the keys
+    # buffer carries the accounting row (lost/updates lanes), the
+    # uint32 counters stay split along the dtype boundary
     "keys": SHARD_LOCAL, "counters": SHARD_LOCAL,
-    "lost": SHARD_LOCAL, "updates": SHARD_LOCAL,
 }
 
 COUNTERS_SPECS: Dict[str, P] = {
@@ -122,6 +124,9 @@ PACKED_GROUP_SPECS: Dict[str, P] = {
     "rep-int32": P(),              # ipcache/LB/prefilter/tunnel copies
     "ct-state": SHARD_LOCAL,       # [8, N+1] conntrack pack (donated)
     "counters": SHARD_LOCAL,       # [2, E*S] counter pack (donated)
+    "flow-state": SHARD_LOCAL,     # 2-leaf flow pack (NOT donated —
+    #                                CPU XLA copies donated scatter
+    #                                buffers; hubble/aggregation.py)
 }
 
 

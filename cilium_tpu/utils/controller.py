@@ -100,6 +100,18 @@ class Controller:
                     self.status.last_failure = time.time()
                 CONTROLLER_RUNS.inc(labels={"name": self.name,
                                             "status": "failure"})
+                if self.status.consecutive_failures == \
+                        FAILING_THRESHOLD:
+                    # crossing the wedged threshold is an incident
+                    # transition (the controller-health degraded
+                    # signal); one event per wedge, not per retry
+                    from ..observability.events import (
+                        EVENT_CONTROLLER_FAILING, recorder)
+                    recorder.record(
+                        EVENT_CONTROLLER_FAILING,
+                        detail=f"{self.name}: "
+                               f"{self.status.last_error}",
+                        consecutive=self.status.consecutive_failures)
                 wait = backoff.next_duration()
             if wait is None:
                 self._wake.wait()

@@ -361,3 +361,20 @@ HUBBLE_RELAY_FAILURES = registry.counter(
 HUBBLE_RELAY_SECONDS = registry.histogram(
     "hubble_relay_peer_seconds",
     "Relay per-peer get_flows fan-out latency")
+
+# Federated cross-shard Hubble series (hubble/federation.py): the
+# sharded daemon's merged flow plane — per-shard device-table drains
+# and the partial/ok accounting of merged shard-attributed answers.
+HUBBLE_FEDERATION_QUERIES = registry.counter(
+    "hubble_federation_queries_total",
+    "Merged cross-shard flow queries served by the federated "
+    "observer, by result (ok = every shard healthy, partial = at "
+    "least one shard degraded or unreadable)")
+HUBBLE_FEDERATION_DRAINED = registry.counter(
+    "hubble_federation_drained_flows_total",
+    "Flow records drained from per-shard device flow tables into the "
+    "federated stores, by shard")
+HUBBLE_FEDERATION_SHARDS = registry.gauge(
+    "hubble_federation_shards",
+    "Federated observer shard planes by state (available = store "
+    "serving and drain breaker closed)")

@@ -227,6 +227,17 @@ class DaemonConfig:
     hubble_flow_probe: int = 8
     # relay fan-out deadline (a dead peer costs at most this per query)
     hubble_relay_deadline_s: float = 2.0
+    # sharded daemons (dataplane_shards >= 2): the federated observer
+    # (hubble/federation.py) drains every shard's device flow table
+    # into its per-shard flow store on this cadence (0 disables the
+    # drain controller; drain() stays callable on demand)
+    hubble_drain_interval_s: float = 1.0
+    # serving SLO tier (observability/slo.py): the latency objective a
+    # resolved ticket is judged against when its lane has no admission
+    # deadline, and the error-budget fraction the burn rate divides by
+    # (0.001 = a 99.9% latency SLO)
+    serving_slo_objective_s: float = 0.050
+    serving_slo_error_budget: float = 0.001
     # runtime self-telemetry (observability/): span tracing +
     # stage/jit/verdict accounting.  Disabling drops the datapath's
     # telemetry cost to ~0 (the tracing-overhead bench's off leg).

@@ -135,6 +135,14 @@ def test_smoke_writes_full_result_file(tmp_path):
                 "fail_static_records",
                 "healthy_shards_stayed_closed"):
         assert key in deg, key
+    # the federated-flows leg is pinned: flows-fused sharded serving
+    # with federation draining concurrently, gated <= 10% overhead
+    fed = ms["extra"]["federated_flows"]
+    for key in ("flows_only_verdicts_per_sec",
+                "federated_verdicts_per_sec",
+                "overhead_vs_flows_only", "gate_overhead_le_10pct",
+                "drains", "federated_queries", "drained_flows"):
+        assert key in fed, key
     # the control-churn schema is pinned: healthy/outage/reconnect
     # legs with journal depth, reconcile time, and the
     # regenerations-avoided-vs-naive-full-resync accounting
@@ -224,6 +232,16 @@ def test_committed_multichip_artifact_is_real():
     assert deg["fail_static_records"] > 0
     assert deg["healthy_shards_stayed_closed"] is True
     assert deg["killed_mode"] == "degraded"
+    # the federated-flows leg: federation draining concurrently must
+    # cost <= 10% vs the flows-only leg (the acceptance gate), with
+    # real drain/query traffic recorded
+    fed = res["extra"]["federated_flows"]
+    assert fed["flows_only_verdicts_per_sec"] > 0
+    assert fed["federated_verdicts_per_sec"] > 0
+    assert fed["gate_overhead_le_10pct"] is True
+    assert fed["overhead_vs_flows_only"] <= 0.10
+    assert fed["drains"] > 0 and fed["federated_queries"] > 0
+    assert fed["drained_flows"] > 0
 
 
 @pytest.mark.parametrize("flag", [True, False])

@@ -869,3 +869,87 @@ def test_service_ids_disjoint_across_families(agent):
     assert c.delete(f"/service/{v6_id}")["deleted"] == v6_id
     remaining = c.get("/service")
     assert len(remaining) == 1 and ":" not in remaining[0]["vip"]
+
+
+# --------------------------------------- incident flight recorder + SLO
+
+def test_flight_recorder_rest_and_cli_events(agent, capsys):
+    """The observability-plane surfaces are pinned: GET /debug/events
+    serves the ordered flight-recorder timeline with cursor paging and
+    type filters, `cilium-tpu events` renders it, and the status SLO
+    block + `status --verbose` top-style table exist."""
+    from cilium_tpu.observability.events import (
+        EVENT_KVSTORE_DEGRADED, EVENT_SERVING_OVERLOAD, recorder)
+    d, srv = agent
+    c = Client(srv.base_url)
+    base = recorder.last_seq
+    e1 = recorder.record(EVENT_KVSTORE_DEGRADED,
+                         detail="test: backend gone", outage=1)
+    e2 = recorder.record(EVENT_SERVING_OVERLOAD, shard=2,
+                         lane="verdict-s2", state="on", pending=999)
+
+    out = c.get(f"/debug/events?since={base}")
+    assert out["seq"] >= e2.seq
+    got = out["events"]
+    assert [e["seq"] for e in got] == [e1.seq, e2.seq]
+    assert got[0]["type"] == "kvstore-degraded"
+    assert got[1]["shard"] == 2
+    assert got[1]["attrs"]["state"] == "on"
+    # cursor paging: since=<first> returns only the second
+    out = c.get(f"/debug/events?since={e1.seq}")
+    assert [e["seq"] for e in out["events"]] == [e2.seq]
+    # type filter
+    out = c.get(f"/debug/events?since={base}&type=serving-overload")
+    assert [e["type"] for e in out["events"]] == ["serving-overload"]
+    # shard filter
+    out = c.get(f"/debug/events?since={base}&shard=2")
+    assert [e["seq"] for e in out["events"]] == [e2.seq]
+
+    from cilium_tpu.cli import main
+    assert main(["--api", srv.base_url, "events",
+                 "--since", str(base)]) == 0
+    text = capsys.readouterr().out
+    assert "kvstore-degraded" in text and "test: backend gone" in text
+    assert "[shard 2] serving-overload" in text
+    assert main(["--api", srv.base_url, "events", "--since",
+                 str(base), "--type", "serving-overload",
+                 "--json"]) == 0
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [e["type"] for e in lines] == ["serving-overload"]
+
+    # the SLO block rides status(); --verbose renders the top table
+    st = c.get("/healthz")
+    assert "lanes" in st["slo"]
+    assert st["flight-recorder"]["seq"] >= e2.seq
+    from cilium_tpu.observability.slo import slo_tracker
+    slo_tracker.observe("verdict", 0.002)
+    slo_tracker.sample_queue("verdict", queued=1, inflight=2,
+                             pending_weight=64)
+    assert main(["--api", srv.base_url, "status", "-v"]) == 0
+    text = capsys.readouterr().out
+    assert "SLO:" in text and "LANE" in text and "BURN" in text
+    assert "FlightRec:" in text
+
+    # bugtool archives the timeline
+    import tarfile
+    from cilium_tpu.bugtool import collect
+    path = collect(d, str(srv.port) + "-fr.tar.gz")
+    with tarfile.open(path) as tar:
+        names = [m.name.split("/", 1)[1] for m in tar.getmembers()]
+        assert "flight-recorder.json" in names
+        assert "slo.json" in names
+    import os
+    os.unlink(path)
+
+
+def test_flows_shard_param_requires_sharded_dataplane(agent):
+    """/flows?shard=K is a sharded-daemon surface: the single-engine
+    daemon answers 400, not a silent empty list."""
+    import urllib.error
+    d, srv = agent
+    c = Client(srv.base_url)
+    from cilium_tpu.cli import APIError
+    with pytest.raises(APIError) as exc:
+        c.get("/flows?shard=0")
+    assert exc.value.status == 400

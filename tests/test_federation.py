@@ -1,0 +1,410 @@
+"""Federated cross-shard Hubble (hubble/federation.py) + the chaos
+acceptance journey: shard-kill + kvstore-flap on a LIVE sharded daemon
+must yield an ordered flight-recorder timeline (trip -> degraded ->
+fail-static -> rebuild -> recovered; kvstore degraded -> reconciling ->
+recovered) and a federated observe answer carrying every shard's flows
+with the degraded shard flagged fail-open.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.hubble.federation import ShardedObserver
+from cilium_tpu.hubble.filter import FlowFilter
+from cilium_tpu.hubble.flow import FlowRecord
+from cilium_tpu.monitor import MonitorHub
+
+
+# --------------------------------------------------- fake shard plane
+
+class FakePlane:
+    """Minimal ShardedDatapath stand-in for observer unit tests."""
+
+    def __init__(self, n_shards=2):
+        self.n_shards = n_shards
+        self.snaps = {k: [] for k in range(n_shards)}
+        self.modes = {k: "ok" for k in range(n_shards)}
+        self.dead = set()
+
+    def shard_flow_snapshot(self, k, max_entries=4096):
+        if k in self.dead:
+            raise RuntimeError("device gone")
+        return list(self.snaps[k])[:max_entries]
+
+    def shard_flow_stats(self, k):
+        return {"slots": 16, "occupied": len(self.snaps[k])}
+
+    def flow_stats(self):
+        return {"slots": 16 * self.n_shards,
+                "occupied": sum(len(s) for s in self.snaps.values())}
+
+    def shard_modes(self):
+        return dict(self.modes)
+
+
+def _agg_row(src, dst, dport, event, packets, nbytes, ls=100):
+    return {"src-identity": src, "dst-identity": dst, "dport": dport,
+            "proto": 6, "event": event, "packets": packets,
+            "bytes": nbytes, "last-seen": ls}
+
+
+class TestShardedObserver:
+    def test_monitor_events_route_by_owning_shard(self):
+        hub = MonitorHub()
+        obs = ShardedObserver(node="n1", datapath=FakePlane(2))
+        obs.attach_monitor(hub)
+        hub.ingest_batch(np.array([-130, 0, 0, -130]),
+                         np.array([0, 1, 2, 3]),
+                         np.array([101, 102, 103, 104]),
+                         np.array([80, 81, 82, 83]),
+                         np.full(4, 6), np.full(4, 100))
+        time.sleep(0.05)
+        flows = obs.get_flows(limit=0)
+        assert {(f["endpoint"], f["shard"]) for f in flows} == \
+            {(0, 0), (1, 1), (2, 0), (3, 1)}
+        # single-shard view
+        only1 = obs.get_flows(shard=1, limit=0)
+        assert {f["endpoint"] for f in only1} == {1, 3}
+        with pytest.raises(ValueError):
+            obs.get_flows(shard=7)
+
+    def test_shared_cursor_merges_and_pages_forward(self):
+        obs = ShardedObserver(node="n1", datapath=FakePlane(2))
+        for i in range(6):
+            obs.ingest(FlowRecord(seq=0, timestamp=float(i),
+                                  node="n1", verdict="FORWARDED",
+                                  endpoint=i))
+        flows = obs.get_flows(limit=0)
+        seqs = [f["seq"] for f in flows]
+        assert seqs == sorted(seqs) == list(range(1, 7))
+        assert obs.last_seq == 6
+        # one cursor pages the MERGED stream across both stores
+        page = obs.get_flows(since=3, limit=2)
+        assert [f["seq"] for f in page] == [4, 5]
+
+    def test_drain_delta_accounting(self):
+        plane = FakePlane(2)
+        obs = ShardedObserver(node="n1", datapath=plane)
+        plane.snaps[0] = [_agg_row(201, 301, 80, 0, 5, 500)]
+        plane.snaps[1] = [_agg_row(202, 302, 443, -130, 3, 300)]
+        out = obs.drain()
+        assert out["drained"] == 2
+        flows = obs.get_flows(limit=0)
+        assert len(flows) == 2
+        drop = next(f for f in flows if f["shard"] == 1)
+        assert drop["verdict"] == "DROPPED"
+        assert drop["drop_reason"] != ""
+        assert "+3 pkts" in drop["summary"]
+        # unchanged counters drain nothing; moved counters drain the
+        # delta only
+        assert obs.drain()["drained"] == 0
+        plane.snaps[0] = [_agg_row(201, 301, 80, 0, 9, 900)]
+        out = obs.drain()
+        assert out["drained"] == 1
+        newest = obs.get_flows(limit=1)[0]
+        assert "+4 pkts" in newest["summary"]
+
+    def test_drain_fail_open_breaker_per_shard(self):
+        plane = FakePlane(2)
+        plane.snaps[0] = [_agg_row(201, 301, 80, 0, 5, 500)]
+        plane.dead.add(1)
+        obs = ShardedObserver(node="n1", datapath=plane)
+        out = obs.drain()
+        # the healthy shard drains; the dead one is a flagged error
+        assert out["shards"]["0"]["status"] == "ok"
+        assert out["shards"]["1"]["status"] == "error"
+        obs.drain()  # second failure opens the breaker
+        out = obs.drain()
+        assert out["shards"]["1"]["status"] == "breaker-open"
+        sts = {s["shard"]: s for s in obs.shard_statuses()}
+        assert sts[1]["status"] == "drain-degraded"
+        assert sts[0]["status"] == "ok"
+        # heal: the breaker's half-open probe readmits the shard
+        plane.dead.clear()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if obs.drain()["shards"]["1"]["status"] == "ok":
+                break
+            time.sleep(0.05)
+        assert {s["shard"]: s["status"]
+                for s in obs.shard_statuses()} == {0: "ok", 1: "ok"}
+
+    def test_degraded_shard_flagged_fail_static(self):
+        plane = FakePlane(2)
+        plane.modes[1] = "degraded"
+        obs = ShardedObserver(node="n1", datapath=plane)
+        obs.ingest(FlowRecord(seq=0, timestamp=1.0, node="n1",
+                              verdict="FORWARDED", endpoint=1))
+        ans = obs.local_answer(limit=10)
+        assert ans["partial"] is True
+        sts = {s["shard"]: s["status"] for s in ans["shards"]}
+        assert sts == {0: "ok", 1: "fail-static"}
+        # the degraded shard's flows stay IN the answer (fail-open)
+        assert any(f["shard"] == 1 for f in ans["flows"])
+
+    def test_stats_aggregate_across_shards(self):
+        """Satellite: hubble stats on sharded daemons must aggregate
+        across shards instead of reporting the first observer's view:
+        the store totals sum every shard store, the aggregation block
+        is the mesh-wide flow_stats, and the hubble_* counters grow
+        for traffic on EVERY shard."""
+        from cilium_tpu.utils.metrics import (HUBBLE_DROPS,
+                                              HUBBLE_FLOWS_PROCESSED)
+        plane = FakePlane(2)
+        obs = ShardedObserver(node="n1", datapath=plane)
+        processed0 = HUBBLE_FLOWS_PROCESSED.total()
+        drops0 = HUBBLE_DROPS.total()
+        for k in (0, 1):
+            obs.ingest(FlowRecord(
+                seq=0, timestamp=1.0, node="n1", verdict="DROPPED",
+                drop_reason="Policy denied", endpoint=k,
+                src_identity=200 + k))
+        assert HUBBLE_FLOWS_PROCESSED.total() == processed0 + 2
+        assert HUBBLE_DROPS.total() == drops0 + 2
+        st = obs.stats()
+        assert st["store"]["ringed"] == 2
+        assert st["aggregation"] == plane.flow_stats()
+        assert set(st["per-shard"]) == {"0", "1"}
+
+    def test_relay_propagates_shard_statuses(self):
+        """Relay extension: a sharded peer's per-shard fail-open flags
+        ride its node status, and a degraded shard makes the merged
+        answer partial even though every peer answered."""
+        from cilium_tpu.hubble.relay import HubbleRelay
+
+        def local_fetch(query, since, limit):
+            return {"flows": [{"seq": 1, "timestamp": 1.0,
+                               "verdict": "FORWARDED", "shard": 1}],
+                    "shards": [{"shard": 0, "status": "ok"},
+                               {"shard": 1, "status": "fail-static"}]}
+
+        relay = HubbleRelay(local_name="n1", local_fetch=local_fetch)
+        out = relay.get_flows(limit=10)
+        assert out["partial"] is True
+        node = out["nodes"][0]
+        assert node["status"] == "ok"
+        assert node["shards"][1]["status"] == "fail-static"
+        assert out["flows"][0]["shard"] == 1
+
+
+# ------------------------------------------- chaos acceptance journey
+
+class _FlakyKV:
+    """BackendOperations pass-through with a blackhole switch: while
+    engaged, every op raises (the etcd-blackhole analog without the
+    proxy machinery)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.blackholed = False
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in ("get", "get_prefix", "list_prefix", "set",
+                    "delete", "delete_prefix", "create_only",
+                    "create_if_exists", "lock_path", "renew_lease"):
+            def guarded(*a, **kw):
+                if self.blackholed:
+                    raise ConnectionError("kvstore blackholed")
+                return attr(*a, **kw)
+            return guarded
+        return attr
+
+
+def test_sharded_daemon_shard_kill_plus_kvstore_flap_timeline():
+    """THE acceptance journey: on a live sharded daemon, a shard kill
+    plus a kvstore flap produce one ordered flight-recorder timeline
+    telling the whole story (trip -> degraded -> FAIL-STATIC ->
+    rebuild -> recovery on the dataplane; degraded -> reconciling ->
+    recovered on the control plane), and `hubble observe --federated`
+    returns flows from ALL shards with the degraded shard flagged
+    fail-open."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from cilium_tpu.cli import Client
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.kvstore.memory import InMemoryBackend
+    from cilium_tpu.observability.events import (
+        EVENT_DATAPLANE_DEGRADED, EVENT_DATAPLANE_FAIL_STATIC,
+        EVENT_DATAPLANE_REBUILD, EVENT_DATAPLANE_RECOVERED,
+        EVENT_DATAPLANE_TRIP, EVENT_KVSTORE_DEGRADED,
+        EVENT_KVSTORE_RECONCILING, EVENT_KVSTORE_RECOVERED, recorder)
+    from cilium_tpu.policy.jsonio import rules_from_json
+    from cilium_tpu.utils.faultinject import DeviceFaultInjector
+    from cilium_tpu.utils.option import DaemonConfig
+
+    flaky = _FlakyKV(InMemoryBackend())
+    cfg = DaemonConfig(
+        state_dir="", drift_audit_interval_s=0,
+        ct_checkpoint_interval_s=0, dataplane_shards=2,
+        hubble_flow_slots=1 << 8, hubble_drain_interval_s=0,
+        supervisor_failure_threshold=1, supervisor_reset_s=0.05,
+        supervisor_watchdog_s=5.0,
+        enable_kvstore_survival=True, kvstore_failure_threshold=1,
+        kvstore_probe_interval_s=0.05)
+    d = Daemon(config=cfg, kvstore_backend=flaky)
+    server = APIServer(d).start()
+    try:
+        d.endpoint_create(1, ipv4="10.200.0.10",
+                          labels=["k8s:id=web"])
+        d.endpoint_create(2, ipv4="10.200.0.11", labels=["k8s:id=db"])
+        rules = rules_from_json(json.dumps([{
+            "endpointSelector": {"matchLabels": {"id": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"id": "web"}}],
+                "toPorts": [{"ports": [{"port": "5432",
+                                        "protocol": "TCP"}]}]}],
+            "labels": ["k8s:policy=t"]}]))
+        rev = d.policy_add(rules)
+        assert d.wait_for_policy_revision(rev, timeout=120)
+
+        slot1 = d.endpoints.lookup(1).table_slot
+        slot2 = d.endpoints.lookup(2).table_slot
+        assert slot1 % 2 != slot2 % 2  # one endpoint per shard
+        victim = slot2 % 2
+        lane = d.datapath.serving()
+        sup = lane.lanes[victim].supervisor
+        web_ip = (10 << 24) | (200 << 16) | 10
+        db_ip = (10 << 24) | (200 << 16) | 11
+
+        def records(slots, dport, sport0):
+            n = len(slots)
+            return {
+                "endpoint": np.asarray(slots, np.int32),
+                "saddr": np.full(n, web_ip, np.uint32).view(np.int32),
+                "daddr": np.full(n, db_ip, np.uint32).view(np.int32),
+                "sport": (sport0 + np.arange(n)).astype(np.int32),
+                "dport": np.full(n, dport, np.int32),
+                "proto": np.full(n, 6, np.int32),
+                "direction": np.zeros(n, np.int32),
+                "tcp_flags": np.full(n, 0x02, np.int32),
+                "is_fragment": np.zeros(n, np.int32),
+                "length": np.full(n, 256, np.int32)}
+
+        # traffic on BOTH shards -> both device flow tables populate
+        both = records([slot1, slot2] * 8, 5432, 40000)
+        t = lane.submit_records(
+            {k: v.copy() for k, v in both.items()}, 16)
+        t.result(timeout=120)
+        assert t.error is None
+        sup.oracle.refresh()
+        # drain the per-shard device flow tables into the federated
+        # stores: the complete flow plane, shard-attributed
+        drained = d.hubble.drain()["drained"]
+        assert drained > 0
+        flows = d.hubble.get_flows(limit=0)
+        assert {f["shard"] for f in flows} == {0, 1}
+
+        seq0 = recorder.last_seq
+
+        # ---- shard kill -------------------------------------------
+        inj = DeviceFaultInjector()
+        sup.install_fault_hook(inj)
+        inj.fail_launch(times=1, fatal=True)
+        kill = records([slot2] * 8, 5432, 41000)
+        t = lane.submit_records(kill, 8)
+        t.result(timeout=120)
+        assert t.error is None          # fail-static, not denied
+        assert sup.mode == "degraded"
+
+        # federated observe WHILE degraded: flows from all shards,
+        # the degraded shard flagged fail-open
+        c = Client(server.base_url)
+        out = c.get("/flows?federated=true&n=500")
+        assert out["partial"] is True
+        node = out["nodes"][0]
+        shard_status = {s["shard"]: s["status"]
+                        for s in node["shards"]}
+        assert shard_status[victim] == "fail-static"
+        assert shard_status[1 - victim] == "ok"
+        assert {f.get("shard") for f in out["flows"]} >= {0, 1}
+        # the plain sharded answer carries the same flags
+        local = c.get("/flows?n=500")
+        assert local["partial"] is True
+        assert {s["shard"]: s["status"] for s in local["shards"]} \
+            == shard_status
+        # CLI: `hubble observe --shard K` scopes one fault domain
+        import io
+        import sys as _sys
+        from cilium_tpu.cli import main as cli_main
+        buf = io.StringIO()
+        old_stdout = _sys.stdout
+        _sys.stdout = buf
+        try:
+            rc = cli_main(["--api", server.base_url, "hubble",
+                           "observe", "--shard", str(victim),
+                           "--json", "-n", "500"])
+        finally:
+            _sys.stdout = old_stdout
+        assert rc == 0
+        rows = [json.loads(line) for line in
+                buf.getvalue().strip().splitlines()
+                if line.startswith("{")]
+        assert rows and all(r["shard"] == victim for r in rows)
+
+        # ---- kvstore flap -----------------------------------------
+        flaky.blackholed = True
+        deadline = time.time() + 30.0
+        while d._kv_guard.mode != "degraded" and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert d._kv_guard.mode == "degraded"
+        flaky.blackholed = False
+        deadline = time.time() + 30.0
+        while d._kv_guard.mode != "ok" and time.time() < deadline:
+            time.sleep(0.05)
+        assert d._kv_guard.mode == "ok"
+
+        # ---- shard recovery ---------------------------------------
+        inj.heal()
+        deadline = time.time() + 30.0
+        while sup.mode != "ok" and time.time() < deadline:
+            time.sleep(0.05)
+            lane.submit_records(
+                records([slot2] * 8, 5432, 42000), 8).result(
+                timeout=120)
+        assert sup.mode == "ok"
+
+        # ---- the ordered timeline ---------------------------------
+        evs = recorder.events(since=seq0, limit=0)
+
+        def first(typ, shard=None, **attrs):
+            for e in evs:
+                if e.type != typ:
+                    continue
+                if shard is not None and e.shard != shard:
+                    continue
+                if any(e.attrs.get(k) != v for k, v in attrs.items()):
+                    continue
+                return e.seq
+            raise AssertionError(
+                f"no {typ} (shard={shard}, {attrs}) in "
+                f"{[(e.seq, e.type, e.shard) for e in evs]}")
+
+        trip = first(EVENT_DATAPLANE_TRIP, shard=victim)
+        degraded = first(EVENT_DATAPLANE_DEGRADED, shard=victim)
+        static = first(EVENT_DATAPLANE_FAIL_STATIC, shard=victim)
+        rebuild = first(EVENT_DATAPLANE_REBUILD, shard=victim,
+                        result="ok")
+        recovered = first(EVENT_DATAPLANE_RECOVERED, shard=victim)
+        assert trip < degraded < static < rebuild < recovered, \
+            [(e.seq, e.type, e.shard) for e in evs]
+        kv_down = first(EVENT_KVSTORE_DEGRADED)
+        kv_sync = first(EVENT_KVSTORE_RECONCILING)
+        kv_up = first(EVENT_KVSTORE_RECOVERED)
+        assert kv_down < kv_sync < kv_up
+        # the dataplane and control-plane stories interleave in ONE
+        # ordered record — the whole incident, `cilium-tpu events`
+        assert degraded < kv_up and kv_down < recovered
+        timeline = recorder.timeline(since=seq0)
+        assert any("fail-static" in line for line in timeline)
+        # recovered: the federated answer drops the flags
+        out = c.get("/flows?n=500")
+        assert {s["status"] for s in out["shards"]} == {"ok"}
+    finally:
+        server.shutdown()
+        d.shutdown()

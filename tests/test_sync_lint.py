@@ -35,6 +35,13 @@ HOT_MODULES = (
     # delta write-through all sit under the engine lock on the
     # control->dataplane boundary — a sync here stalls every dispatch
     "cilium_tpu/parallel/packing.py",
+    # the observability plane rides the dispatch path (SLO hooks per
+    # resolved ticket, flight-recorder emitters on mode transitions,
+    # the federated observer's drain): pure host arithmetic, zero
+    # sync markers by construction
+    "cilium_tpu/observability/slo.py",
+    "cilium_tpu/observability/events.py",
+    "cilium_tpu/hubble/federation.py",
 )
 
 # the engine is hot only in its dispatch functions — table loading,
