@@ -105,6 +105,35 @@ def _smoke_result():
                                   "strategy": "stride", "k": 3,
                                   "dtype": "int32", "classes": 29,
                                   "states": 96}}}
+    # the l7-fast config's pinned output schema: proxy-bypass rate,
+    # per-request fast vs proxy-bound percentiles per protocol, and
+    # the disabled-path byte-identity gate
+    suite["l7-fast"] = {
+        "metric": "l7_fast_proxy_bypass_rate", "value": 80,
+        "unit": "%", "vs_baseline": 1.6,
+        "extra": {"smoke": True, "window": 128,
+                  "programs": {"programs": 2, "regexes": 7,
+                               "states": 120, "k": 2, "classes": 30,
+                               "window": 128,
+                               "resident_bytes": 500000,
+                               "protocols": {"http": 1, "dns": 1}},
+                  "batch": 4096, "requests_per_sec": 2_000_000,
+                  "bypass_rate": 0.8, "decided_on_device": 3277,
+                  "undecidable_mix": 0.2,
+                  "http": {"requests": 120, "fast_p50_us": 400.0,
+                           "fast_p99_us": 800.0,
+                           "proxy_p50_us": 900.0,
+                           "proxy_p99_us": 2400.0,
+                           "proxy_connections_fast_leg": 0,
+                           "proxy_connections_proxy_leg": 125,
+                           "p99_speedup": 3.0},
+                  "dns": {"requests": 120, "fast_p50_us": 380.0,
+                          "fast_p99_us": 750.0,
+                          "engine_p50_us": 9.0,
+                          "engine_p99_us": 25.0},
+                  "gate_bypass_ge_50pct": True,
+                  "gate_fast_p99_beats_proxy": True,
+                  "fast_disabled_byte_identical": True}}
     # the overload config's pinned output schema: per-multiplier legs
     # with accepted-latency percentiles + shed accounting, admission
     # control vs the unbounded pre-change queue
@@ -483,6 +512,7 @@ def run_bench():
         for name in ("latency-tier", "dispatch-floor", "overload",
                      "mesh-shard",
                      "identity-l4", "http-regex", "kafka-acl", "fqdn",
+                     "l7-fast",
                      "capacity", "incremental", "flows-overhead",
                      "tracing-overhead", "provenance-overhead",
                      "control-churn"):

@@ -83,6 +83,29 @@ def compile_endpoints(map_states: Sequence[PolicyMapState],
                           max_probe=max_probe, num_endpoints=e, slots=s)
 
 
+def compile_l7_classification(value: np.ndarray,
+                              port_to_prog: Dict[int, int]
+                              ) -> np.ndarray:
+    """The per-slot L7 fast-verdict classification table: map the
+    compiled value tensor (slot proxy ports; 0 = plain allow) to fused
+    DFA program ids — ``-1`` keeps redirect-to-proxy, ``>= 0`` marks
+    the slot first-bytes-decidable by that program (the eligibility
+    bit IS prog >= 0).  Emitted alongside the verdict tables for every
+    generation and re-derived per dirty row on the delta-apply fast
+    path (datapath/engine._apply_dirty_rows_locked); the fused stage
+    gathers it at the matched slot (datapath/pipeline._l7_fast_stage).
+
+    ``port_to_prog`` comes from the eligible-redirect classification
+    (l7/fast.classify + build_fast_programs).  Vectorized over any
+    value shape; dtype int32 so the table joins the ep-int32 packed
+    dispatch group."""
+    out = np.full(value.shape, -1, np.int32)
+    for port, prog in port_to_prog.items():
+        if port > 0:
+            out[value == port] = prog
+    return out
+
+
 def oracle_verdict(state: PolicyMapState, identity: int, dport: int,
                    proto: int, direction: int) -> int:
     """Scalar reference of the 3-stage datapath lookup

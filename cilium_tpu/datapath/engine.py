@@ -168,6 +168,18 @@ class Datapath:
         self.last_provenance: Optional[Provenance] = None
         self._replay_probe = 1
         self._prov_decode_cache = None
+        # on-device L7 fast verdicts (l7/fast.L7FastPrograms): when
+        # set, both family steps fuse the fast-verdict stage — the
+        # per-slot classification + fused DFA tables join the packed
+        # dispatch buffers and the steps take a [B, W] payload lane.
+        # None = the exact pre-fast compiled program.
+        self._l7_fast = None
+        self._l7_rw4 = None        # (jitted l7_prog row writer, gidx)
+        self._l7_rw6 = None
+        # cached absent-payload staging (all -1 = not decidable ->
+        # redirect) per batch size, so payload-less callers of an
+        # L7-enabled engine pay no per-batch allocation
+        self._absent_payloads: Dict[int, np.ndarray] = {}
 
     @property
     def counters(self) -> Optional[Counters]:
@@ -231,6 +243,67 @@ class Datapath:
             self.last_provenance = None
             if self._step is not None:
                 self._rebuild()
+
+    def enable_l7_fast(self, programs) -> None:
+        """Turn on the on-device L7 fast-verdict stage: both family
+        steps gain the fused DFA walk over a [B, W] payload lane,
+        deciding first-bytes-decidable redirects inline (allow /
+        DROP_POLICY_L7) and falling back to redirect-to-proxy for
+        truncated/absent payloads or redirect-needing rules.
+
+        ``programs`` is an l7/fast.L7FastPrograms (built from the
+        eligible redirects by l7/fast.programs_from_redirects or
+        build_fast_programs).  Re-jits the steps; the per-slot
+        classification and DFA tables join the packed dispatch."""
+        with self._lock:
+            self._l7_fast = programs
+            self._absent_payloads = {}
+            if self._step is not None:
+                self._rebuild()
+
+    def disable_l7_fast(self) -> None:
+        """Back to the exact pre-fast compiled program: every L7 rule
+        redirects to its proxy port again."""
+        with self._lock:
+            if self._l7_fast is None:
+                return
+            self._l7_fast = None
+            self._absent_payloads = {}
+            if self._step is not None:
+                self._rebuild()
+
+    def l7_fast_report(self) -> Optional[Dict]:
+        """Program-set report (bench extras / status surfaces)."""
+        with self._lock:
+            progs = self._l7_fast
+        return None if progs is None else progs.describe()
+
+    def l7_fast_window(self) -> int:
+        """The payload window W callers must encode to (0 = fast
+        verdicts disabled; payloads are ignored then).  Read per
+        serving launch — lock-free on purpose (the reference is
+        swapped atomically by enable/disable; a racy read costs one
+        absent-payload batch, never a wrong verdict)."""
+        progs = self._l7_fast
+        return 0 if progs is None else progs.window
+
+    def l7_fast_protocol_of(self):
+        """Slot -> protocol tag decoder for the l7_fast_verdicts_total
+        metric (monitor.MonitorHub.ingest_batch l7_proto_of): maps a
+        provenance match slot to the decided program's protocol via
+        the live value tensor (the slot's proxy port)."""
+        with self._lock:
+            progs = self._l7_fast
+        if progs is None:
+            return None
+        decode = self.rule_decoder()
+
+        def proto_of(slot) -> str:
+            entry = decode(slot)
+            if entry is None:
+                return ""
+            return progs.protocol_of_port(entry.get("proxy-port", 0))
+        return proto_of
 
     def flow_snapshot(self, max_entries: int = 4096):
         """Decoded per-flow aggregates ([] when disabled).  Snapshot
@@ -413,7 +486,8 @@ class Datapath:
                                         count=len(dirty)))
         kid = jnp.asarray(np.stack([r[0] for r in dirty.values()]))
         kmeta = jnp.asarray(np.stack([r[1] for r in dirty.values()]))
-        kval = jnp.asarray(np.stack([r[2] for r in dirty.values()]))
+        kval_np = np.stack([r[2] for r in dirty.values()])
+        kval = jnp.asarray(kval_np)
         for attr, rw in (("_tbufs4", self._rw4), ("_tbufs6", self._rw6)):
             bufs = getattr(self, attr)
             if bufs is None or rw is None:
@@ -422,6 +496,29 @@ class Datapath:
             out = list(bufs)
             out[gidx] = writer(out[gidx], slots, kid, kmeta, kval)
             setattr(self, attr, tuple(out))
+        if self._l7_fast is not None:
+            # L7 classification write-through: the dirty rows' proxy
+            # ports re-derive their per-slot program ids, scattered
+            # into both family packs (and the unpacked tables view the
+            # replay surface reads) — an L7 rule change on the fast
+            # path stays a row write, never a repack
+            l7rows = jnp.asarray(
+                self._l7_fast.progs_for_values(kval_np))
+            for attr, rw in (("_tbufs4", self._l7_rw4),
+                             ("_tbufs6", self._l7_rw6)):
+                bufs = getattr(self, attr)
+                if bufs is None or rw is None:
+                    continue
+                writer, gidx = rw
+                out = list(bufs)
+                out[gidx] = writer(out[gidx], slots, l7rows)
+                setattr(self, attr, tuple(out))
+            if self._tables is not None and \
+                    self._tables.l7_prog is not None:
+                lp = self._tables.l7_prog.at[slots].set(l7rows)
+                self._tables = self._tables._replace(l7_prog=lp)
+                if self._tables6 is not None:
+                    self._tables6 = self._tables6._replace(l7_prog=lp)
         self._pack_stats["row-writes"] += len(dirty)
         if telem:
             record_stage("engine", "flatten",
@@ -606,12 +703,31 @@ class Datapath:
         # the slot->identity table serves both the encap stage and the
         # flow-aggregation key, so it is always device-resident
         ep_ident = jnp.asarray(self._ep_identity)
+        # L7 fast-verdict tables (l7/fast.py): the per-slot program
+        # classification derives from the live value tensor (slot
+        # proxy port -> program id), so it recompiles with every
+        # table generation; omitted entirely when fast verdicts are
+        # off, keeping the no-L7 program byte-identical
+        l7_kwargs = {}
+        l7_static = {}
+        if self._l7_fast is not None:
+            progs = self._l7_fast
+            vals_np = np.asarray(dp.value)
+            l7_kwargs = dict(
+                l7_prog=jnp.asarray(progs.progs_for_values(vals_np)),
+                l7_flat=jnp.asarray(progs.flat),
+                l7_map=jnp.asarray(progs.cmap),
+                l7_accept=jnp.asarray(progs.accept),
+                l7_starts=jnp.asarray(progs.starts),
+                l7_pmask=jnp.asarray(progs.pmask))
+            l7_static = dict(with_l7_fast=1, l7_k=progs.k,
+                             l7_c1=progs.c1)
         self._tables = FullTables(
             datapath=dp, lb=self.lb.compiled.tables,
             pf_masks=jnp.asarray(pf.masks), pf_key_a=jnp.asarray(pf.key_a),
             pf_key_b=jnp.asarray(pf.key_b), pf_value=jnp.asarray(pf.value),
             pf_plens=jnp.asarray(pf.prefix_lens),
-            ep_identity=ep_ident, **tun_kwargs)
+            ep_identity=ep_ident, **tun_kwargs, **l7_kwargs)
         if self._counters is None or self._counters.shape[1] != n:
             self._counters = make_counter_pack(n)
         flow_kwargs = {}
@@ -638,7 +754,7 @@ class Datapath:
             lb_probe=self.lb.compiled.max_probe,
             ct_slots=self.ct.slots, ct_probe=self.ct.max_probe,
             tun_probe=tun_probe)
-        self._statics4 = {**v4_static, **flow_kwargs}
+        self._statics4 = {**v4_static, **flow_kwargs, **l7_static}
 
         # v6 twin: shares the (family-agnostic) policy tensors, runs
         # the 4-word LPMs for prefilter/ipcache and its own CT table.
@@ -652,14 +768,15 @@ class Datapath:
             key_id=dp.key_id, key_meta=dp.key_meta, value=dp.value,
             ipcache6=lpm6_tables(ipc6), pf6=lpm6_tables(pf6),
             lb6=lb6.tables if lb6 is not None else None,
-            router_ip6=self._router_ip6, ep_identity=ep_ident)
+            router_ip6=self._router_ip6, ep_identity=ep_ident,
+            **l7_kwargs)
         v6_static = dict(
             policy_probe=policy_probe,
             lpm6_probe=max(1, ipc6.max_probe),
             pf6_probe=max(1, pf6.max_probe),
             ct_slots=self.ct6.slots, ct_probe=self.ct6.max_probe,
             lb6_probe=lb6.max_probe if lb6 is not None else 0)
-        self._statics6 = {**v6_static, **flow_kwargs}
+        self._statics6 = {**v6_static, **flow_kwargs, **l7_static}
 
         # mesh placement: commit every table onto this shard's column
         # submesh so the jitted steps compile as submesh-resident SPMD
@@ -677,13 +794,14 @@ class Datapath:
         self._refresh_packs_locked()
 
         def grouped(step_fn, unpack, statics):
-            def g(tbufs, ct, counters, batch, now, flows=None):
+            def g(tbufs, ct, counters, batch, now, flows=None,
+                  payload=None):
                 tables = unpack(tbufs)
-                if flows is None:
+                if flows is None and payload is None:
                     return step_fn(tables, ct, counters, batch, now,
                                    **statics)
                 return step_fn(tables, ct, counters, batch, now,
-                               flows, **statics)
+                               flows, payload, **statics)
             return jax.jit(g, donate_argnums=(1, 2))
 
         from ..parallel import packing
@@ -728,6 +846,8 @@ class Datapath:
         self._tbufs4, self._tbufs6 = bufs4, bufs6
         self._rw4 = packing.make_policy_row_writer(self._manifest4)
         self._rw6 = packing.make_policy_row_writer(self._manifest6)
+        self._l7_rw4 = packing.make_l7_prog_row_writer(self._manifest4)
+        self._l7_rw6 = packing.make_l7_prog_row_writer(self._manifest6)
         self._pack_stats["full-packs"] += 1
         if telem:
             record_stage("engine", "flatten",
@@ -753,18 +873,23 @@ class Datapath:
             if self._step_packed is None:
                 raise RuntimeError("no policy loaded")
             flows = () if self.flows is None else (self.flows.state,)
+            payload = () if self._l7_fast is None else (
+                np.zeros((1, self._l7_fast.window), np.int32),)
             packed_args = (self._tbufs4, self.ct.state, self._counters,
-                           np.zeros((10, 1), np.int32), 0) + flows
+                           np.zeros((10, 1), np.int32), 0) + flows \
+                + payload
             n_packed = len(tree_leaves(packed_args))
             # v6 keeps the per-field packet batch (10 leaves) but the
             # same grouped tables/state
             n_v6 = (len(tree_leaves((self._tbufs6, self.ct6.state,
                                      self._counters))) + 10 + 1
-                    + len(tree_leaves(flows)))
+                    + len(tree_leaves(flows))
+                    + len(tree_leaves(payload)))
             # the legacy-pytree equivalent: raw table leaves + per-leaf
             # CT state + per-leaf counters + batch + timestamp
             n_legacy = (len(tree_leaves(self._tables)) + 8 + 2 + 1 + 1
-                        + len(tree_leaves(flows)))
+                        + len(tree_leaves(flows))
+                        + len(tree_leaves(payload)))
             return {"packed-step": n_packed,
                     "v6-step": n_v6,
                     "legacy-step": n_legacy,
@@ -772,9 +897,15 @@ class Datapath:
 
     def _lower_args_packed(self, packed, now: int = 1):
         """The exact argument tuple ``_step_packed`` dispatches —
-        the jit-lowering/introspection surface for tests."""
-        return (self._tbufs4, self.ct.state, self._counters, packed,
+        the jit-lowering/introspection surface for tests.  An
+        L7-enabled engine's step takes the payload lane too (absent
+        matrix stands in, as for payload-less dispatch)."""
+        args = (self._tbufs4, self.ct.state, self._counters, packed,
                 jnp.int32(now))
+        if self._l7_fast is not None:
+            args = args + (None, jnp.asarray(
+                self._payload_in(None, int(packed.shape[1]))))
+        return args
 
     # -- the hot path --------------------------------------------------------
 
@@ -801,14 +932,49 @@ class Datapath:
         self._ts_cache = (val, ts)
         return ts
 
-    def process(self, pkt: FullPacketBatch, now: Optional[int] = None):
+    def _payload_in(self, payload, rows: int):
+        """The payload lane for one dispatch (lock held): the caller's
+        [rows, W] block when L7 fast verdicts are on, a cached
+        all-(-1) absent matrix when the caller carried none (absent =
+        not decidable = redirect, the exact pre-fast verdicts), and
+        None when the fast stage is disabled (the payload is never
+        traced, keeping the compiled program byte-identical)."""
+        if self._l7_fast is None:
+            return None
+        if payload is not None:
+            return payload
+        cached = self._absent_payloads.get(rows)
+        if cached is None:
+            cached = np.full((rows, self._l7_fast.window), -1, np.int32)
+            self._absent_payloads[rows] = cached
+        return cached
+
+    def _dispatch_locked(self, step, tbufs, ct_state, batch, ts,
+                         flows_in, payload):
+        """One jitted-step call with the optional flows/payload lanes
+        threaded positionally (lock held).  Call shapes stay stable
+        per configuration, so the jit cache sees one entry."""
+        if payload is not None:
+            return step(tbufs, ct_state, self._counters, batch, ts,
+                        flows_in, payload)
+        if flows_in is not None:
+            return step(tbufs, ct_state, self._counters, batch, ts,
+                        flows_in)
+        return step(tbufs, ct_state, self._counters, batch, ts)
+
+    def process(self, pkt: FullPacketBatch, now: Optional[int] = None,
+                payload=None):
         """Classify a batch. Returns (verdict, event, identity, nat) —
         nat carries the DNAT'd forward tuple and rev-NAT'd reply tuple.
 
         Dispatch is asynchronous: the returned arrays are in-flight
         device values; nothing here blocks on device compute, and the
         engine lock covers ONLY the dispatch + state swap (timestamp
-        upload happens before it, telemetry accounting after)."""
+        upload happens before it, telemetry accounting after).
+
+        ``payload`` is the optional [B, W] L7 payload lane (int32
+        match-string bytes, l7/fast.encode_payloads) consumed by the
+        fast-verdict stage when enabled; ignored otherwise."""
         telem = self.telemetry_enabled
         t0 = time.perf_counter() if telem else 0.0
         ts = self._timestamp(now)
@@ -816,15 +982,17 @@ class Datapath:
             if self._step is None:
                 raise RuntimeError("no policy loaded")
             t_lock = time.perf_counter() if telem else 0.0
+            pl = self._payload_in(payload, int(pkt.endpoint.shape[0]))
             if self.flows is not None:
                 step = self._flow_step_variant(self._step,
                                                self._step_nc)
-                outs = step(self._tbufs4, self.ct.state, self._counters,
-                            pkt, ts, self.flows.state)
+                flows_in = self.flows.state
             else:
                 step = self._step
-                outs = step(self._tbufs4, self.ct.state, self._counters,
-                            pkt, ts)
+                flows_in = None
+            outs = self._dispatch_locked(step, self._tbufs4,
+                                         self.ct.state, pkt, ts,
+                                         flows_in, pl)
             verdict, event, identity, nat = outs[:4]
             self.ct.state, self._counters = outs[4], outs[5]
             tail = 6
@@ -844,10 +1012,10 @@ class Datapath:
         return verdict, event, identity, nat
 
     def process6(self, pkt: FullPacketBatch6,
-                 now: Optional[int] = None):
+                 now: Optional[int] = None, payload=None):
         """Classify a v6 batch (bpf_lxc.c:745 ipv6_policy path).
-        Returns (verdict, event, identity, nat6).  Same async-dispatch
-        and narrow-lock contract as process()."""
+        Returns (verdict, event, identity, nat6).  Same async-dispatch,
+        narrow-lock and payload-lane contract as process()."""
         telem = self.telemetry_enabled
         t0 = time.perf_counter() if telem else 0.0
         ts = self._timestamp(now)
@@ -855,15 +1023,17 @@ class Datapath:
             if self._step6 is None:
                 raise RuntimeError("no policy loaded")
             t_lock = time.perf_counter() if telem else 0.0
+            pl = self._payload_in(payload, int(pkt.sport.shape[0]))
             if self.flows is not None:
                 step = self._flow_step_variant(self._step6,
                                                self._step6_nc)
-                outs = step(self._tbufs6, self.ct6.state,
-                            self._counters, pkt, ts, self.flows.state)
+                flows_in = self.flows.state
             else:
                 step = self._step6
-                outs = step(self._tbufs6, self.ct6.state,
-                            self._counters, pkt, ts)
+                flows_in = None
+            outs = self._dispatch_locked(step, self._tbufs6,
+                                         self.ct6.state, pkt, ts,
+                                         flows_in, pl)
             verdict, event, identity, nat = outs[:4]
             self.ct6.state, self._counters = outs[4], outs[5]
             tail = 6
@@ -882,13 +1052,19 @@ class Datapath:
             self._notify_revision_served(served)
         return verdict, event, identity, nat
 
-    def process_packed(self, packed, now: Optional[int] = None):
+    def process_packed(self, packed, now: Optional[int] = None,
+                       payload=None):
         """Classify a v4 batch given as ONE [10, B] int32 field matrix
         (pipeline.PACKED_FIELDS order) — the serving dispatcher's hot
         entry: a single H2D transfer per batch instead of ten, with
         the per-field unpack fused into the compiled program.  Same
         verdict/event/identity/nat outputs, same async-dispatch and
-        narrow-lock contract as process()."""
+        narrow-lock contract as process().
+
+        ``payload`` is the optional [B, W] L7 payload lane riding
+        beside the field matrix (its own H2D) when the fast-verdict
+        stage is enabled; payload-less batches get the cached absent
+        matrix (every L7 rule redirects, the pre-fast behavior)."""
         telem = self.telemetry_enabled
         t0 = time.perf_counter() if telem else 0.0
         ts = self._timestamp(now)
@@ -901,15 +1077,17 @@ class Datapath:
             if self._step_packed is None:
                 raise RuntimeError("no policy loaded")
             t_lock = time.perf_counter() if telem else 0.0
+            pl = self._payload_in(payload, int(packed.shape[1]))
             if self.flows is not None:
                 step = self._flow_step_variant(self._step_packed,
                                                self._step_packed_nc)
-                outs = step(self._tbufs4, self.ct.state, self._counters,
-                            packed, ts, self.flows.state)
+                flows_in = self.flows.state
             else:
                 step = self._step_packed
-                outs = step(self._tbufs4, self.ct.state, self._counters,
-                            packed, ts)
+                flows_in = None
+            outs = self._dispatch_locked(step, self._tbufs4,
+                                         self.ct.state, packed, ts,
+                                         flows_in, pl)
             verdict, event, identity, nat = outs[:4]
             self.ct.state, self._counters = outs[4], outs[5]
             tail = 6

@@ -76,6 +76,13 @@ TIER_L7_REDIRECT = 5     # matched key carries a proxy port
 TIER_DENY = 6            # no key matched (policy/fragment drop)
 TIER_LB = 7              # answered by the local service tier (ICMPv6
 #                          NS/echo responder; nothing reaches policy)
+# On-device L7 fast verdicts (datapath/pipeline.py fast-verdict stage):
+# the matched key carried a proxy port, but the rule set is first-
+# bytes-decidable and the payload window decided inline — the flow
+# never reaches the proxy.  Redirect-needing rules (header-spanning,
+# kafka, body) and truncated/absent payloads keep TIER_L7_REDIRECT.
+TIER_L7_FAST_ALLOW = 8   # DFA matched: allowed inline on device
+TIER_L7_FAST_DENY = 9    # DFA refused: denied inline (DROP_POLICY_L7)
 
 TIER_NAMES = {
     TIER_NONE: "none",
@@ -86,6 +93,8 @@ TIER_NAMES = {
     TIER_L7_REDIRECT: "l7-redirect",
     TIER_DENY: "deny",
     TIER_LB: "lb",
+    TIER_L7_FAST_ALLOW: "l7-fast-allow",
+    TIER_L7_FAST_DENY: "l7-fast-deny",
 }
 
 

@@ -181,6 +181,17 @@ class FullTables(NamedTuple):
     tun_value: jnp.ndarray = None
     tun_plens: jnp.ndarray = None
     ep_identity: jnp.ndarray = None   # [E] local slot -> own identity
+    # On-device L7 fast-verdict tables (l7/fast.L7FastPrograms): the
+    # per-slot program classification emitted by the policy compiler
+    # plus the fused class-compressed k-stride DFA walked inline by
+    # the fast-verdict stage.  All None = fast verdicts disabled (the
+    # compiled program is byte-identical to the pre-fast step).
+    l7_prog: jnp.ndarray = None       # [E, S] slot -> program id (-1)
+    l7_flat: jnp.ndarray = None       # [S * c1**k] stride table
+    l7_map: jnp.ndarray = None        # [258] byte+2 -> class
+    l7_accept: jnp.ndarray = None     # [S] 0/1 per-state accept
+    l7_starts: jnp.ndarray = None     # [R] per-regex start state
+    l7_pmask: jnp.ndarray = None      # [P, R] program -> regex rows
 
 
 def _flow_identities(ep_identity, endpoint, peer_identity, direction):
@@ -266,7 +277,7 @@ def host_fail_static_step(soa, n: int, *, established, identity_of,
 
 def full_datapath_step_packed(tables: FullTables, ct,
                               counters: Counters, packed, now,
-                              flows=None, **statics):
+                              flows=None, payload=None, **statics):
     """full_datapath_step over ONE [10, B] int32 field matrix.
 
     The latency-tier fix for small-batch dispatch overhead: ten
@@ -274,22 +285,80 @@ def full_datapath_step_packed(tables: FullTables, ct,
     ~80 us apiece on the CPU backend — batch-size independent)
     collapse into a single H2D of the packed matrix; the per-field
     unpack is row slicing INSIDE the jitted program, which XLA fuses
-    away.  Field order is PACKED_FIELDS."""
+    away.  Field order is PACKED_FIELDS.  ``payload`` is the optional
+    [B, W] L7 payload lane (its own buffer beside the field matrix —
+    present only when the fast-verdict stage is compiled in, so the
+    no-L7 program keeps its exact argument list)."""
     pkt = FullPacketBatch(**{f: packed[i]
                              for i, f in enumerate(PACKED_FIELDS)})
     return full_datapath_step(tables, ct, counters, pkt, now,
-                              flows, **statics)
+                              flows, payload, **statics)
+
+
+def _l7_fast_stage(tables, payload, pol_verdict, pol_slot, *,
+                   k: int, c1: int):
+    """The on-device L7 fast-verdict stage (l7/fast.py tables): where
+    the policy verdict is a redirect whose matched slot carries a
+    first-bytes-decidable program AND the payload window is present
+    and untruncated, walk the fused class-compressed k-stride DFA and
+    decide allow/deny inline — the flow never reaches the proxy.
+    Everything else keeps the redirect verdict (fail-to-redirect,
+    never fail-open).
+
+    Returns (verdict', fast_allow [B], fast_deny [B])."""
+    from ..ops.dfa_engine import _packed_walk
+    from .verdict import VERDICT_DROP_L7
+    prog_flat = tables.l7_prog.reshape(-1)
+    slot = jnp.clip(pol_slot, 0, prog_flat.shape[0] - 1)
+    prog = jnp.where(pol_slot >= 0, prog_flat[slot], jnp.int32(-1))
+    eligible = (pol_verdict > 0) & (prog >= 0)
+    # decidability: an absent (all -1) payload or a window-truncation
+    # poison row (-2, the encode_strings overlong contract) cannot be
+    # judged from first bytes — those flows redirect to the proxy
+    has_payload = payload[:, 0] >= 0
+    truncated = jnp.any(payload == jnp.int32(-2), axis=1)
+    b, w = payload.shape
+    # class map + stride pack + ceil(W/k) dependent gathers: the
+    # ops/dfa_engine stride strategy fused into this program (negative
+    # bytes map to the identity class, which composes as the identity
+    # function — pads freeze states exactly like the standalone engine)
+    cls = tables.l7_map[payload + jnp.int32(2)]
+    pad = (-w) % k
+    if pad:
+        cls = jnp.concatenate(
+            [cls, jnp.full((b, pad), c1 - 1, jnp.int32)], axis=1)
+    grp = cls.reshape(b, -1, k)
+    idx = grp[:, :, 0]
+    for j in range(1, k):
+        idx = idx * jnp.int32(c1) + grp[:, :, j]
+    n_regex = tables.l7_starts.shape[0]
+    states = jnp.broadcast_to(tables.l7_starts[None, :],
+                              (b, n_regex)).astype(jnp.int32)
+    final = _packed_walk(c1 ** k, tables.l7_flat, states, idx)
+    hit = tables.l7_accept[final] != 0              # [B, R]
+    n_prog = tables.l7_pmask.shape[0]
+    own = tables.l7_pmask[jnp.clip(prog, 0, n_prog - 1)]
+    l7_allow = jnp.any(hit & (own != 0), axis=1)
+    fast = eligible & has_payload & ~truncated
+    fast_allow = fast & l7_allow
+    fast_deny = fast & ~l7_allow
+    verdict = jnp.where(
+        fast_allow, jnp.int32(0),
+        jnp.where(fast_deny, jnp.int32(VERDICT_DROP_L7), pol_verdict))
+    return verdict, fast_allow, fast_deny
 
 
 def full_datapath_step(tables: FullTables, ct, counters: Counters,
                        pkt: FullPacketBatch, now: jnp.ndarray,
-                       flows=None, *,
+                       flows=None, payload=None, *,
                        policy_probe: int, lpm_probe: int, pf_probe: int,
                        lb_probe: int, ct_slots: int, ct_probe: int,
                        tun_probe: int = 0, flow_slots: int = 0,
                        flow_probe: int = 0,
                        flow_claim_budget: int = 1024,
-                       with_provenance: int = 0):
+                       with_provenance: int = 0,
+                       with_l7_fast: int = 0, l7_k: int = 1,
+                       l7_c1: int = 2):
     """The batched equivalent of the reference's per-packet egress path
     (bpf_lxc.c:432 handle_ipv4_from_lxc): XDP prefilter drop, service
     DNAT (lb4_local), conntrack lookup, ipcache identity resolve, policy
@@ -307,12 +376,21 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
     matched policymap entry's flat slot (-1 = no entry decided) and
     the decision-tier code (events.TIER_*).  0 keeps the compiled
     program identical to the pre-provenance step.
+
+    ``with_l7_fast`` (static) fuses the on-device L7 fast-verdict
+    stage: redirect verdicts whose matched slot names a first-bytes-
+    decidable program (tables.l7_*) are decided inline from the
+    [B, W] ``payload`` lane — allow (0) or DROP_POLICY_L7 — and fall
+    back to redirect-to-proxy for truncated/absent payloads.  0 keeps
+    the compiled program byte-identical to the pre-fast step (the
+    payload arg is never passed then).
     """
     from .conntrack import CT_NEW, CTBatch, ct_step
-    from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_PREFILTER,
-                         TRACE_TO_LXC, TRACE_TO_PROXY)
+    from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_POLICY_L7,
+                         DROP_PREFILTER, TRACE_TO_LXC, TRACE_TO_PROXY)
     from .lb import lb_step
-    from .verdict import VERDICT_ALLOW, VERDICT_DROP, VERDICT_DROP_FRAG
+    from .verdict import (VERDICT_ALLOW, VERDICT_DROP, VERDICT_DROP_FRAG,
+                          VERDICT_DROP_L7)
 
     # 1. Prefilter (bpf_xdp.c:158 check_filters).
     if tables.pf_key_a.shape[0] > 0:
@@ -361,7 +439,10 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                      dport=dport, proto=pkt.proto,
                      direction=pkt.direction, length=pkt.length,
                      is_fragment=pkt.is_fragment)
-    if with_provenance:
+    if with_provenance or with_l7_fast:
+        # the fast-verdict stage needs the matched slot even when
+        # provenance outputs are off (the unused tier is dead code XLA
+        # eliminates; the lookups are shared either way)
         pol_verdict, counters, pol_slot, pol_tier = verdict_step(
             tables.datapath.key_id, tables.datapath.key_meta,
             tables.datapath.value, counters, vb, policy_probe,
@@ -370,6 +451,14 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
         pol_verdict, counters = verdict_step(
             tables.datapath.key_id, tables.datapath.key_meta,
             tables.datapath.value, counters, vb, policy_probe)
+
+    # 5.5 On-device L7 fast verdict: decide first-bytes-decidable
+    # redirects inline from the payload lane — a fast-allowed flow
+    # creates its CT entry with proxy port 0 (the whole connection
+    # bypasses the proxy), a fast-denied flow creates nothing.
+    if with_l7_fast:
+        pol_verdict, l7_fast_allow, l7_fast_deny = _l7_fast_stage(
+            tables, payload, pol_verdict, pol_slot, k=l7_k, c1=l7_c1)
 
     # 6. CT step. Creation is gated on the policy allowing the flow
     # (bpf_lxc.c:545 ct_create4 after policy_can_egress); prefilter-
@@ -405,6 +494,11 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                   jnp.where(verdict < 0, jnp.int32(DROP_POLICY),
                             jnp.where(verdict > 0, jnp.int32(TRACE_TO_PROXY),
                                       jnp.int32(TRACE_TO_LXC)))))
+    if with_l7_fast:
+        # VERDICT_DROP_L7 is produced only by the fast stage, so the
+        # final verdict identifies inline L7 denials exactly
+        event = jnp.where(verdict == jnp.int32(VERDICT_DROP_L7),
+                          jnp.int32(DROP_POLICY_L7), event)
 
     # 9. Overlay encap (encap.h encap_and_redirect): allowed egress
     # packets whose (DNAT'd) destination falls in a peer node's pod
@@ -456,6 +550,15 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
         # fast-path hits next, then the policy tiers.  Slots stay -1
         # wherever no compiled policymap entry decided.
         from .events import TIER_CT_ESTABLISHED, TIER_PREFILTER
+        if with_l7_fast:
+            # the fast stage decided where it fired (and nothing above
+            # it did): report the fast tier, keeping the matched
+            # redirect entry as the attributed slot
+            from .events import TIER_L7_FAST_ALLOW, TIER_L7_FAST_DENY
+            pol_tier = jnp.where(
+                l7_fast_allow, jnp.int32(TIER_L7_FAST_ALLOW),
+                jnp.where(l7_fast_deny, jnp.int32(TIER_L7_FAST_DENY),
+                          pol_tier))
         tier = jnp.where(
             pf_hit, jnp.int32(TIER_PREFILTER),
             jnp.where(established, jnp.int32(TIER_CT_ESTABLISHED),
@@ -553,6 +656,15 @@ class FullTables6(NamedTuple):
     # [E] local slot -> own security identity (shared with the v4
     # tables; the flow-aggregation stage keys on it)
     ep_identity: jnp.ndarray = None
+    # L7 fast-verdict tables (shared with the v4 family — the policy
+    # tensors and therefore the per-slot classification are family-
+    # agnostic); all None = fast verdicts disabled
+    l7_prog: jnp.ndarray = None
+    l7_flat: jnp.ndarray = None
+    l7_map: jnp.ndarray = None
+    l7_accept: jnp.ndarray = None
+    l7_starts: jnp.ndarray = None
+    l7_pmask: jnp.ndarray = None
 
 
 def lpm6_tables(c) -> LPM6Tables:
@@ -573,28 +685,34 @@ def fold6(words: jnp.ndarray) -> jnp.ndarray:
 
 def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                         pkt: FullPacketBatch6, now: jnp.ndarray,
-                        flows=None, *,
+                        flows=None, payload=None, *,
                         policy_probe: int, lpm6_probe: int,
                         pf6_probe: int, ct_slots: int, ct_probe: int,
                         lb6_probe: int = 0, flow_slots: int = 0,
                         flow_probe: int = 0,
                         flow_claim_budget: int = 1024,
-                        with_provenance: int = 0):
+                        with_provenance: int = 0,
+                        with_l7_fast: int = 0, l7_k: int = 1,
+                        l7_c1: int = 2):
     """The v6 twin of full_datapath_step (bpf_lxc.c:745 ipv6_policy):
     prefilter drop, service DNAT (lb6_local), conntrack, ipcache
     identity, policy verdict for CT_NEW flows, CT create gated on the
-    verdict, reply-path reverse NAT (lb6_rev_nat).
+    verdict, reply-path reverse NAT (lb6_rev_nat).  ``with_l7_fast``
+    fuses the same on-device L7 fast-verdict stage as the v4 family
+    (the policy tensors and per-slot classification are shared).
 
     Returns (verdict [B], event [B], identity [B], nat6, ct',
     counters').
     """
     from ..ops.lpm_ops import lpm6_lookup
     from .conntrack import CT_NEW, CTBatch, ct_step
-    from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_PREFILTER,
-                         DROP_UNKNOWN_TARGET, ICMP6_ECHO_REPLY,
-                         ICMP6_NS_REPLY, TRACE_TO_LXC, TRACE_TO_PROXY)
+    from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_POLICY_L7,
+                         DROP_PREFILTER, DROP_UNKNOWN_TARGET,
+                         ICMP6_ECHO_REPLY, ICMP6_NS_REPLY, TRACE_TO_LXC,
+                         TRACE_TO_PROXY)
     from .lb import lb6_rev_nat, lb6_step
-    from .verdict import VERDICT_DROP, VERDICT_DROP_FRAG, verdict_step
+    from .verdict import (VERDICT_DROP, VERDICT_DROP_FRAG,
+                          VERDICT_DROP_L7, verdict_step)
 
     b = pkt.sport.shape[0]
 
@@ -675,7 +793,7 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                      dport=dport, proto=pkt.proto,
                      direction=pkt.direction, length=pkt.length,
                      is_fragment=pkt.is_fragment)
-    if with_provenance:
+    if with_provenance or with_l7_fast:
         pol_verdict, counters, pol_slot, pol_tier = verdict_step(
             tables.key_id, tables.key_meta, tables.value, counters,
             vb, policy_probe, count_mask=~icmp6_handled,
@@ -684,6 +802,11 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
         pol_verdict, counters = verdict_step(
             tables.key_id, tables.key_meta, tables.value, counters, vb,
             policy_probe, count_mask=~icmp6_handled)
+
+    # 5.5 On-device L7 fast verdict (same stage as the v4 family).
+    if with_l7_fast:
+        pol_verdict, l7_fast_allow, l7_fast_deny = _l7_fast_stage(
+            tables, payload, pol_verdict, pol_slot, k=l7_k, c1=l7_c1)
 
     # 6. CT step, creation gated on the verdict; new entries record the
     # flow's rev-NAT index so replies can restore the VIP.  Locally
@@ -725,6 +848,9 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                             jnp.where(verdict > 0,
                                       jnp.int32(TRACE_TO_PROXY),
                                       jnp.int32(TRACE_TO_LXC))))))))
+    if with_l7_fast:
+        event = jnp.where(verdict == jnp.int32(VERDICT_DROP_L7),
+                          jnp.int32(DROP_POLICY_L7), event)
     nat = NAT6Result(daddr=daddr, dport=dport, saddr=nat_saddr,
                      sport=nat_sport, rev_nat=ct_rev_nat)
     out = (verdict, event, identity, nat, ct, counters)
@@ -749,6 +875,12 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
         # service tier decided, not policy), then CT, then policy.
         from .events import (TIER_CT_ESTABLISHED, TIER_LB,
                              TIER_PREFILTER)
+        if with_l7_fast:
+            from .events import TIER_L7_FAST_ALLOW, TIER_L7_FAST_DENY
+            pol_tier = jnp.where(
+                l7_fast_allow, jnp.int32(TIER_L7_FAST_ALLOW),
+                jnp.where(l7_fast_deny, jnp.int32(TIER_L7_FAST_DENY),
+                          pol_tier))
         tier = jnp.where(
             pf_hit, jnp.int32(TIER_PREFILTER),
             jnp.where(icmp6_handled, jnp.int32(TIER_LB),

@@ -506,6 +506,12 @@ class VerdictDispatcher(ContinuousDispatcher):
         # launches
         self._staging: Dict[int, List[np.ndarray]] = {}
         self._staging_tick: Dict[int, int] = {}
+        # the L7 payload lane's staging twin ([rows, W] matrices, same
+        # rotation), allocated only when the engine has fast verdicts
+        # on; rows without a submitted payload stay -1 (absent ->
+        # redirect-to-proxy, the pre-fast behavior)
+        self._pl_staging: Dict[int, List[np.ndarray]] = {}
+        self._pl_tick: Dict[int, int] = {}
         super().__init__(self._launch_records, self._finalize_records,
                          self._deny_records, max_batch=max_batch,
                          depth=depth, window=window,
@@ -517,12 +523,16 @@ class VerdictDispatcher(ContinuousDispatcher):
                          supervisor=supervisor)
 
     def submit_records(self, soa: Dict[str, np.ndarray], n: int,
-                       deadline: Optional[float] = None) -> Ticket:
+                       deadline: Optional[float] = None,
+                       payload: Optional[np.ndarray] = None) -> Ticket:
         """Queue ``n`` records given as the PacketRing SoA dict (int32
         arrays, caller-owned — they are read once at pack time on the
         dispatcher thread, so hand over fresh arrays, not ring-backed
-        views)."""
-        return self.submit((soa, int(n)), deadline=deadline)
+        views).  ``payload`` is the optional [n, W] int32 L7 payload
+        block (l7/fast.encode_payloads) riding with the records into
+        the fused fast-verdict stage; None = every L7 rule redirects
+        for these records."""
+        return self.submit((soa, int(n), payload), deadline=deadline)
 
     # ------------------------------------------------------------- pack
 
@@ -537,24 +547,60 @@ class VerdictDispatcher(ContinuousDispatcher):
         self._staging_tick[rows] = tick + 1
         return ring[tick % len(ring)]
 
+    def _pl_stage_for(self, rows: int, width: int) -> np.ndarray:
+        ring = self._pl_staging.get(rows)
+        if ring is None or ring[0].shape[1] != width:
+            ring = self._pl_staging[rows] = [
+                np.empty((rows, width), np.int32)
+                for _ in range(self.depth + 1)]
+            self._pl_tick[rows] = 0
+        tick = self._pl_tick[rows]
+        self._pl_tick[rows] = tick + 1
+        return ring[tick % len(ring)]
+
     def _launch_records(self, items, total: int):
         telem = self._telemetry()
         t0 = time.perf_counter() if telem else 0.0
         rows = bucket_size(total, self._min_rows)
         stage = self._stage_for(rows)
+        width = 0
+        l7_window = getattr(self._datapath, "l7_fast_window", None)
+        if l7_window is not None:
+            width = l7_window()
+        pstage = self._pl_stage_for(rows, width) if width else None
         off = 0
-        for soa, n in items:
+        for item in items:
+            soa, n, pl = item[0], item[1], item[2] \
+                if len(item) > 2 else None
             for fi, f in enumerate(PACKED_FIELDS):
                 stage[fi, off:off + n] = soa[f][:n]
+            if pstage is not None:
+                if pl is None:
+                    pstage[off:off + n] = -1
+                else:
+                    w = min(width, pl.shape[1])
+                    pstage[off:off + n, :w] = pl[:n, :w]
+                    if w < width:
+                        pstage[off:off + n, w:] = -1
+                    if pl.shape[1] > width:
+                        # bytes beyond the engine window: poison the
+                        # overflowing rows (fail-to-redirect) instead
+                        # of silently judging a truncated string
+                        over = (pl[:n, width:] >= 0).any(axis=1)
+                        pstage[off:off + n][over] = -2
             off += n
         # pad rows are copies of the first real record: they re-touch
         # an existing flow's CT entry instead of minting new keys
         stage[:, total:rows] = stage[:, :1]
+        if pstage is not None:
+            # pad payloads stay absent: a duplicated header row with a
+            # real payload could flip the pad's verdict arm
+            pstage[total:rows] = -1
         if telem:
             record_stage(self.family, "pack",
                          time.perf_counter() - t0)
         verdict, _event, identity, _nat = \
-            self._datapath.process_packed(stage)
+            self._datapath.process_packed(stage, payload=pstage)
         return verdict, identity
 
     def _finalize_records(self, handle, weights: Sequence[int]):
@@ -571,6 +617,6 @@ class VerdictDispatcher(ContinuousDispatcher):
 
     @staticmethod
     def _deny_records(item):
-        _soa, n = item
+        n = item[1]
         return (np.full(n, DROP_POLICY, np.int32),
                 np.zeros(n, np.int32))

@@ -551,8 +551,11 @@ class DeviceSupervisor:
             except Exception as e:  # noqa: BLE001 — no host view at
                 return None, e      # all: fail closed
         try:
-            results = [self.oracle.classify(soa, n)
-                       for soa, n in items]
+            # items are (soa, n[, payload]) chunks; the host oracle
+            # answers policy, not L7 — fast-eligible flows degrade to
+            # their redirect verdict (fail-to-redirect holds degraded)
+            results = [self.oracle.classify(item[0], item[1])
+                       for item in items]
         except Exception as e:  # noqa: BLE001 — a broken oracle must
             return None, e      # fall back to fail-closed deny
         self.fail_static_batches += 1

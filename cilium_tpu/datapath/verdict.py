@@ -29,6 +29,9 @@ from ..ops.hashtab_ops import batched_lookup
 
 VERDICT_DROP = -1       # DROP_POLICY analog
 VERDICT_DROP_FRAG = -2  # DROP_FRAG_NOSUPPORT analog
+VERDICT_DROP_L7 = -3    # DROP_POLICY_L7 analog: denied inline by the
+#                         on-device L7 fast-verdict stage (the matched
+#                         key carried a proxy port, the payload decided)
 VERDICT_ALLOW = 0       # TC_ACT_OK; >0 == proxy redirect port
 
 

@@ -1,0 +1,275 @@
+"""L7 fast-verdict program compiler: classify which L7 rules are
+first-bytes-decidable and lower them into ONE fused DFA table set the
+jitted verdict pipelines can walk inline.
+
+Per PAPERS.md "Offloading L7 Policies to the Kernel" most L7 decisions
+are decidable from the first bytes of a connection without stream
+state, and per hXDP the win comes from executing the whole decision in
+the fast path instead of punting.  Today every L7 rule costs a full
+proxy round-trip per connection: the packed serving lane computes
+``redirect-to-proxy-port``, the socket proxy accepts the stream, and
+only then does the DFA engine decide.  This module is the compile-time
+half of making redirect-to-proxy the exception:
+
+- **Eligibility classification** — an HTTP redirect whose every rule is
+  method/path/host regex only (no header requirements — headers can
+  span packets and need the assembled head) is first-bytes-decidable;
+  a DNS redirect's qname selectors always are.  Kafka, body-inspection
+  and custom parser rules are NOT — they keep the proxy path.  An
+  empty (allow-all) rule set also keeps the proxy: it exists for
+  visibility, not matching, and the fast path must never silence it.
+
+- **Program fusion** — every eligible redirect's patterns compile into
+  a SINGLE stacked DFA (compiler/regexc.compile_regex_set) with
+  byte-equivalence-class compression and a host-precomposed k-stride
+  table (the ops/dfa_engine stride strategy), so the fused pipeline
+  walks ALL programs' regexes together in ceil(W/k) dependent gathers
+  and reduces per packet with a per-program regex mask.  The verdict
+  is bit-exact with the proxy-side engines over the same match string
+  (same compiler, same tables, same anchored-overlong semantics).
+
+Payload convention (the ``[B, W]`` int32 payload lane): the protocol
+match string — ``method\\x00path\\x00host`` for HTTP (l7/http
+``_request_line``), the canonical lowercased qname for DNS — padded
+with -1; rows whose true string exceeds the window are poisoned with
+-2 (ops/dfa_ops.encode_strings contract).  Absent (all -1) or
+poisoned rows are NOT decidable and fall back to redirect-to-proxy:
+fail-to-redirect, never fail-open.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..compiler.regexc import compile_regex_set
+
+# protocol tags (the l7_fast_verdicts_total metric label values)
+FAST_HTTP = "http"
+FAST_DNS = "dns"
+
+# stride-table bounds for the FUSED walk: the table rides the packed
+# dispatch buffers of every batch, so it is budgeted tighter than the
+# standalone DFAEngine (which owns a whole device)
+MAX_FAST_COLS = 1 << 15
+FAST_STRIDE_BUDGET = 8 << 20
+MAX_FAST_STRIDE = 4
+# default payload window: covers real-world request lines and qnames
+# while keeping the per-packet H2D cost bounded (W int32 lanes/packet)
+DEFAULT_WINDOW = 64
+
+
+def classify_http(rules) -> Optional[List[str]]:
+    """Combined method/path/host patterns when the HTTP rule set is
+    first-bytes-decidable, else None (redirect-to-proxy).
+
+    Ineligible: empty rule sets (allow-all redirects keep the proxy
+    for visibility) and any rule with header requirements — headers
+    arrive after the request line and may span packets."""
+    from .http import _rule_to_combined_regex
+    rules = list(rules or [])
+    if not rules:
+        return None
+    patterns = []
+    for r in rules:
+        if getattr(r, "headers", None):
+            return None  # header-spanning: needs the assembled head
+        patterns.append(_rule_to_combined_regex(r))
+    return patterns
+
+
+def classify_dns(selectors) -> Optional[List[str]]:
+    """qname patterns for a DNS selector set (always first-bytes-
+    decidable: the question rides the first datagram), else None."""
+    selectors = list(selectors or [])
+    if not selectors:
+        return None
+    return [s.to_regex() for s in selectors]
+
+
+def classify(parser_type: str, rules) -> Optional[Tuple[str, List[str]]]:
+    """(protocol tag, patterns) when ``parser_type``'s rule set is
+    first-bytes-decidable, else None.  Kafka (correlation/apiversion
+    state), body-inspection and custom parsers always redirect."""
+    if parser_type == "http":
+        pats = classify_http(rules)
+        return None if pats is None else (FAST_HTTP, pats)
+    if parser_type == "dns":
+        pats = classify_dns(rules)
+        return None if pats is None else (FAST_DNS, pats)
+    return None
+
+
+@dataclass(frozen=True)
+class FastProgramSpec:
+    """One eligible redirect, pre-lowering: the proxy port its policy
+    entries carry, its protocol tag, and its anchored patterns."""
+
+    port: int
+    protocol: str
+    patterns: Tuple[str, ...]
+
+
+@dataclass
+class L7FastPrograms:
+    """The fused device-table set for every first-bytes-decidable L7
+    program: one stacked class-compressed k-stride DFA plus the
+    per-program regex masks, ready to join the packed dispatch.
+
+    All arrays are host numpy (the engine uploads them with the rest
+    of the table generation); dtypes are int32 throughout so the whole
+    set packs into one ``l7-dfa`` dispatch-buffer group."""
+
+    flat: np.ndarray       # [S * c1**k] int32 precomposed stride table
+    cmap: np.ndarray       # [258] int32 byte+2 -> class (identity last)
+    accept: np.ndarray     # [S] int32 0/1 per-state accept
+    starts: np.ndarray     # [R] int32 per-regex start state
+    pmask: np.ndarray      # [P, R] int32 program -> owned regex rows
+    k: int                 # stride (bytes per dependent gather)
+    c1: int                # classes + 1 (identity)
+    window: int            # payload window W
+    port_to_prog: Dict[int, int]
+    protocols: Tuple[str, ...] = ()   # [P] protocol tag per program
+    states: int = 0
+    specs: Tuple[FastProgramSpec, ...] = ()
+
+    def protocol_of_port(self, port: int) -> str:
+        p = self.port_to_prog.get(int(port))
+        return self.protocols[p] if p is not None else ""
+
+    def progs_for_values(self, values: np.ndarray) -> np.ndarray:
+        """Per-slot program ids for a policy value array — delegates
+        to the compiler's classification-table emission
+        (compiler/policy_tables.compile_l7_classification)."""
+        from ..compiler.policy_tables import compile_l7_classification
+        return compile_l7_classification(values, self.port_to_prog)
+
+    def nbytes(self) -> int:
+        return int(self.flat.nbytes + self.cmap.nbytes +
+                   self.accept.nbytes + self.starts.nbytes +
+                   self.pmask.nbytes)
+
+    def describe(self) -> Dict:
+        return {"programs": len(self.protocols),
+                "regexes": int(self.starts.shape[0]),
+                "states": self.states, "k": self.k,
+                "classes": self.c1 - 1, "window": self.window,
+                "resident_bytes": self.nbytes(),
+                "protocols": {p: self.protocols.count(p)
+                              for p in set(self.protocols)}}
+
+
+def build_fast_programs(specs: Sequence[FastProgramSpec],
+                        window: int = DEFAULT_WINDOW) -> L7FastPrograms:
+    """Lower every eligible program into the fused table set.
+
+    All patterns compile into ONE stacked DFA so the fused pipeline
+    pays a single walk regardless of program count; program p owns a
+    contiguous regex-row range recorded in its ``pmask`` row."""
+    specs = tuple(specs)
+    if not specs:
+        raise ValueError("no fast-eligible L7 programs to build")
+    patterns: List[str] = []
+    ranges: List[Tuple[int, int]] = []
+    for spec in specs:
+        start = len(patterns)
+        patterns.extend(spec.patterns)
+        ranges.append((start, len(patterns)))
+    compiled = compile_regex_set(patterns)
+    s = int(compiled.num_states)
+    class_of, class_tab = compiled.byte_classes()
+    num_classes = int(class_tab.shape[1])
+    c1 = num_classes + 1
+    # largest stride whose precomposed table stays in the fused budget
+    k = 1
+    while (k < MAX_FAST_STRIDE and c1 ** (k + 1) <= MAX_FAST_COLS
+           and s * c1 ** (k + 1) * 4 <= FAST_STRIDE_BUDGET):
+        k += 1
+    # identity class appended as the last column: negative bytes (pad/
+    # poison) compose as the identity function, exactly the DFAEngine
+    # stride semantics (ops/dfa_engine)
+    tab_c = np.concatenate(
+        [class_tab, np.arange(s, dtype=np.int32)[:, None]], axis=1)
+    t = tab_c
+    for _ in range(k - 1):
+        t = tab_c[t].reshape(s, -1)
+    flat = np.ascontiguousarray(t.astype(np.int32)).reshape(-1)
+    map258 = np.full(258, num_classes, np.int32)
+    map258[2:] = class_of
+    r = len(patterns)
+    pmask = np.zeros((len(specs), r), np.int32)
+    for p, (a, b) in enumerate(ranges):
+        pmask[p, a:b] = 1
+    return L7FastPrograms(
+        flat=flat, cmap=map258,
+        accept=compiled.accept.astype(np.int32),
+        starts=compiled.starts.astype(np.int32),
+        pmask=pmask, k=k, c1=c1, window=int(window),
+        port_to_prog={int(sp.port): i for i, sp in enumerate(specs)},
+        protocols=tuple(sp.protocol for sp in specs),
+        states=s, specs=specs)
+
+
+def programs_from_redirects(redirects, window: int = DEFAULT_WINDOW,
+                            dns_selectors: Optional[Dict] = None
+                            ) -> Optional[L7FastPrograms]:
+    """Classify a ProxyManager redirect list (plus optional
+    {proxy_port: FQDN selector list} DNS entries) and build the fused
+    program set from the eligible ones.  None when nothing qualifies —
+    every redirect keeps the proxy path."""
+    specs: List[FastProgramSpec] = []
+    for redir in redirects:
+        flt = getattr(redir, "l7_filter", None)
+        rules = None
+        if flt is not None and getattr(flt, "l7_rules_per_ep", None) \
+                is not None:
+            resolved = flt.l7_rules_per_ep.get_relevant_rules(None)
+            rules = resolved.http if resolved is not None else None
+        got = classify(redir.parser_type, rules)
+        if got is None:
+            continue
+        proto, pats = got
+        specs.append(FastProgramSpec(port=int(redir.proxy_port),
+                                     protocol=proto,
+                                     patterns=tuple(pats)))
+    for port, sels in (dns_selectors or {}).items():
+        pats = classify_dns(sels)
+        if pats is not None:
+            specs.append(FastProgramSpec(port=int(port),
+                                         protocol=FAST_DNS,
+                                         patterns=tuple(pats)))
+    if not specs:
+        return None
+    return build_fast_programs(specs, window=window)
+
+
+# ---------------------------------------------------------------------------
+# Payload encoding (the host half of the payload lane)
+# ---------------------------------------------------------------------------
+
+def http_match_string(method: str, path: str, host: str = "") -> str:
+    """The HTTP combined match string — the SAME framing the proxy-side
+    engine matches (l7/http._request_line), so the two tiers can never
+    frame a request differently."""
+    return f"{method}\x00{path}\x00{(host or '').lower()}"
+
+
+def dns_match_string(name: str) -> str:
+    """Canonical qname (lowercased, root dot stripped) — the l7/dns
+    ``_canon`` framing."""
+    return name.lower().rstrip(".")
+
+
+def encode_payloads(strings: Sequence[Optional[str]],
+                    window: int) -> np.ndarray:
+    """Match strings -> the [B, W] int32 payload lane: -1 padding, -2
+    poison for rows longer than the window (fail-to-redirect), and
+    all--1 rows for None entries (payload absent -> redirect)."""
+    from ..ops.dfa_ops import encode_strings
+    out = encode_strings([s or "" for s in strings], window)
+    for i, s in enumerate(strings):
+        if s is None:
+            out[i] = -1
+    return out

@@ -18,10 +18,12 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from .datapath.events import (DROP_NAMES, TIER_NAMES, TRACE_NAMES,
-                              format_denied_key)
+from .datapath.events import (DROP_NAMES, TIER_L7_FAST_ALLOW,
+                              TIER_L7_FAST_DENY, TIER_NAMES,
+                              TRACE_NAMES, format_denied_key)
 from .utils.metrics import (DROP_COUNT, FORWARD_COUNT,
-                            POLICY_RULE_DROPS, POLICY_VERDICT_TIERS)
+                            L7_FAST_VERDICTS, POLICY_RULE_DROPS,
+                            POLICY_VERDICT_TIERS)
 
 # label-cardinality guard: at most this many DISTINCT denied keys are
 # admitted into the per-rule drop counter per ingested batch (the
@@ -107,14 +109,18 @@ class MonitorHub:
 
     def ingest_batch(self, event_codes, endpoints, identities, dports,
                      protos, lengths, tiers=None, match_slots=None,
-                     rule_of=None) -> None:
+                     rule_of=None, l7_proto_of=None) -> None:
         """Aggregate one datapath batch (all args array-like [B]).
 
         ``tiers``/``match_slots`` are the engine's per-packet
         provenance outputs (Datapath.last_provenance) and ``rule_of``
         its slot->string decoder (Datapath.provenance_rule_of): when
         present, samples carry the decision tier + decided rule,
-        verdicts count by tier, and drops aggregate per denied key."""
+        verdicts count by tier, and drops aggregate per denied key.
+        ``l7_proto_of`` (Datapath.l7_fast_protocol_of) maps a match
+        slot to its fast program's protocol tag so rows decided by the
+        on-device L7 fast-verdict stage feed
+        ``l7_fast_verdicts_total{protocol,outcome}``."""
         codes = np.asarray(event_codes)
         eps = np.asarray(endpoints)
         ids = np.asarray(identities)
@@ -140,6 +146,7 @@ class MonitorHub:
                                     np.unique(trs, return_counts=True))):
                 POLICY_VERDICT_TIERS.inc(n, labels={
                     "tier": TIER_NAMES.get(tier, str(tier))})
+            self._count_l7_fast(trs, slots, l7_proto_of)
         rule_drops = self._aggregate_rule_drops(codes, ids, dps, prs,
                                                 slots, rule_of) \
             if trs is not None else {}
@@ -189,6 +196,29 @@ class MonitorHub:
         for fn in subs:
             for ev in samples:
                 fn(ev)
+
+    @staticmethod
+    def _count_l7_fast(trs, slots, l7_proto_of) -> None:
+        """Count rows the on-device L7 fast-verdict stage decided into
+        l7_fast_verdicts_total{protocol,outcome}.  Protocol resolves
+        per distinct match slot (one decode covers the whole group) —
+        the fast tiers always carry the decided redirect entry's
+        slot."""
+        for tier, outcome in ((TIER_L7_FAST_ALLOW, "allow"),
+                              (TIER_L7_FAST_DENY, "deny")):
+            mask = trs == tier
+            total = int(mask.sum())
+            if not total:
+                continue
+            if slots is None or l7_proto_of is None:
+                L7_FAST_VERDICTS.inc(total, labels={
+                    "protocol": "unknown", "outcome": outcome})
+                continue
+            uniq, cnt = np.unique(slots[mask], return_counts=True)
+            for slot, n in zip(uniq.tolist(), cnt.tolist()):
+                proto = l7_proto_of(int(slot)) or "unknown"
+                L7_FAST_VERDICTS.inc(int(n), labels={
+                    "protocol": proto, "outcome": outcome})
 
     @staticmethod
     def _aggregate_rule_drops(codes, ids, dps, prs, slots,

@@ -43,6 +43,14 @@ COUNTERS_GROUP = "counters"
 # keys buffer carries the lost/updates accounting row, counters stay
 # their own uint32 buffer along the dtype boundary
 FLOW_STATE_GROUP = "flow-state"
+# the fused L7 fast-verdict DFA table set (l7/fast.py) packs into its
+# OWN group instead of riding rep-int32: a no-L7 engine then builds
+# the exact pre-fast buffer list, keeping that program byte-identical
+# at the pinned leaf ceiling (the per-slot l7_prog classification
+# shards with the policy rows and stays in ep-int32)
+L7_DFA_GROUP = "l7-dfa"
+_L7_DFA_LEAVES = frozenset(
+    ("l7_flat", "l7_map", "l7_accept", "l7_starts", "l7_pmask"))
 
 
 class LeafSlot(NamedTuple):
@@ -134,7 +142,8 @@ def build_manifest(tables) -> PackManifest:
     for path, arr in _walk(tables):
         spec = spec_table[path]
         dt = str(arr.dtype)
-        group = f"{_sharding_class(spec)}-{dt}"
+        group = L7_DFA_GROUP if path in _L7_DFA_LEAVES \
+            else f"{_sharding_class(spec)}-{dt}"
         off = offsets.get(group, 0)
         size = int(arr.size)
         leaves.append(LeafSlot(path=path, group=group, offset=off,
@@ -228,6 +237,29 @@ def make_policy_row_writer(manifest: PackManifest):
         vals = jnp.concatenate([kid.reshape(-1), kmeta.reshape(-1),
                                 kval.reshape(-1)])
         return buf.at[idx].set(vals)
+
+    return jax.jit(write), gidx
+
+
+def make_l7_prog_row_writer(manifest: PackManifest):
+    """Row writer for the per-slot L7 classification table: the
+    delta-apply twin of :func:`make_policy_row_writer` for the
+    ``l7_prog`` leaf, so an L7 rule change on the refresh fast path
+    stays a row scatter.  Returns None when the manifest carries no
+    l7_prog leaf (fast verdicts disabled)."""
+    import jax
+
+    leaf = manifest.leaf("l7_prog")
+    if leaf is None:
+        return None
+    gidx = manifest.group_names().index(leaf.group)
+    off = leaf.offset
+    n_slots = leaf.shape[1]
+
+    def write(buf, slots, rows):
+        col = jnp.arange(n_slots, dtype=jnp.int32)[None, :]
+        idx = off + slots[:, None].astype(jnp.int32) * n_slots + col
+        return buf.at[idx.reshape(-1)].set(rows.reshape(-1))
 
     return jax.jit(write), gidx
 

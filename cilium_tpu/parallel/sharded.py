@@ -228,7 +228,8 @@ class ShardedServingLane:
         self.lanes = [sh.serving() for sh in plane.shards]
 
     def submit_records(self, soa: Dict[str, np.ndarray], n: int,
-                       deadline: Optional[float] = None
+                       deadline: Optional[float] = None,
+                       payload: Optional[np.ndarray] = None
                        ) -> ShardedTicket:
         n = int(n)
         n_shards = self.plane.n_shards
@@ -244,8 +245,10 @@ class ShardedServingLane:
                    for f in PACKED_FIELDS}
             sub["endpoint"] = (sub["endpoint"]
                                // n_shards).astype(np.int32)
+            pl = None if payload is None else \
+                np.ascontiguousarray(payload[:n][idx])
             parts.append((idx, lane.submit_records(
-                sub, int(idx.size), deadline=deadline)))
+                sub, int(idx.size), deadline=deadline, payload=pl)))
         return ShardedTicket(n, parts)
 
     @property
@@ -516,6 +519,24 @@ class ShardedDatapath:
     def disable_provenance(self) -> None:
         for sh in self.shards:
             sh.disable_provenance()
+
+    def enable_l7_fast(self, programs) -> None:
+        """Fan the L7 fast-verdict program set to every shard (the
+        fused DFA tables are replicated per shard, like the other
+        address/payload-keyed lookups; l7_prog shards with the policy
+        rows each shard already owns)."""
+        for sh in self.shards:
+            sh.enable_l7_fast(programs)
+
+    def disable_l7_fast(self) -> None:
+        for sh in self.shards:
+            sh.disable_l7_fast()
+
+    def l7_fast_window(self) -> int:
+        return self.shards[0].l7_fast_window()
+
+    def l7_fast_report(self):
+        return self.shards[0].l7_fast_report()
 
     # -------------------------------------------------------- serving
 

@@ -70,6 +70,17 @@ LB6_TABLES_SPECS: Dict[str, P] = {
     "rev_vip": REPLICATED, "rev_port": REPLICATED,
 }
 
+# On-device L7 fast-verdict tables (l7/fast.py): the per-slot program
+# classification shards with the policy rows it annotates; the fused
+# DFA table set is replicated — any shard's packets may carry any
+# payload (its packed dispatch-buffer group is "l7-dfa" below).
+L7_FAST_SPECS: Dict[str, P] = {
+    "l7_prog": EP_ROWS,
+    "l7_flat": REPLICATED, "l7_map": REPLICATED,
+    "l7_accept": REPLICATED, "l7_starts": REPLICATED,
+    "l7_pmask": REPLICATED,
+}
+
 FULL_TABLES_SPECS: Dict[str, P] = {
     **{f"datapath.{k}": v for k, v in DATAPATH_TABLES_SPECS.items()},
     **{f"lb.{k}": v for k, v in LB_TABLES_SPECS.items()},
@@ -80,6 +91,7 @@ FULL_TABLES_SPECS: Dict[str, P] = {
     "tun_key_b": REPLICATED, "tun_value": REPLICATED,
     "tun_plens": REPLICATED,
     "ep_identity": EP_VEC,
+    **L7_FAST_SPECS,
 }
 
 FULL_TABLES6_SPECS: Dict[str, P] = {
@@ -89,6 +101,7 @@ FULL_TABLES6_SPECS: Dict[str, P] = {
     **{f"lb6.{k}": v for k, v in LB6_TABLES_SPECS.items()},
     "router_ip6": REPLICATED,
     "ep_identity": EP_VEC,
+    **L7_FAST_SPECS,
 }
 
 # mutable per-shard state: every leaf lives on its owning shard alone
@@ -120,8 +133,13 @@ COUNTERS_SPECS: Dict[str, P] = {
 # ---------------------------------------------------------------------------
 
 PACKED_GROUP_SPECS: Dict[str, P] = {
-    "ep-int32": P(EP_AXIS),        # stacked policy rows + slot identities
+    "ep-int32": P(EP_AXIS),        # stacked policy rows + slot
+    #                                identities + l7_prog classification
     "rep-int32": P(),              # ipcache/LB/prefilter/tunnel copies
+    "l7-dfa": P(),                 # fused L7 fast-verdict DFA table set
+    #                                (l7/fast.py; its own group so the
+    #                                no-L7 program keeps its exact
+    #                                buffer list), replicated per shard
     "ct-state": SHARD_LOCAL,       # [8, N+1] conntrack pack (donated)
     "counters": SHARD_LOCAL,       # [2, E*S] counter pack (donated)
     "flow-state": SHARD_LOCAL,     # 2-leaf flow pack (NOT donated —

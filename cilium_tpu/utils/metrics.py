@@ -299,6 +299,13 @@ DATAPLANE_SHARD_FAULTS = registry.counter(
     "shard index and kind")
 PROXY_REDIRECTS = registry.gauge(
     "proxy_redirects", "Number of active proxy redirects")
+# On-device L7 fast verdicts (datapath/pipeline.py fast-verdict stage
+# + l7/fast.py): connections decided inline by the fused DFA instead
+# of a proxy round-trip, by protocol and outcome (allow / deny).
+L7_FAST_VERDICTS = registry.counter(
+    "l7_fast_verdicts_total",
+    "L7 requests decided inline by the on-device fast-verdict stage "
+    "(proxy bypassed), by protocol and outcome")
 PROXY_UPSTREAM_TIME = registry.histogram(
     "proxy_upstream_reply_seconds", "Proxy upstream reply time")
 DROP_COUNT = registry.counter(

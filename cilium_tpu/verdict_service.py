@@ -35,9 +35,21 @@ the native PKT_HEADER_DTYPE layout (LITTLE-endian fields, 24B/record,
 ABI-checked against the C++ struct):
   request : u32 0xC111A901 | u32 frame_id | u32 count |
             count * 24B PKT_HEADER_DTYPE records
+  request+payload (L7 fast-verdict lane):
+            u32 0xC111A903 | u32 frame_id | u32 count | u32 window |
+            count * 24B records | count * window u8 payload bytes
+            (0xFF = padding, 0xFE = window-truncation poison — L7
+            match strings are ASCII, so both are unambiguous)
   response: u32 0xC111A902 | u32 frame_id | u32 count |
             count * i32 verdict (big-endian) |
             count * i32 identity (big-endian)
+
+Payload-carrying frames feed the engine's fused L7 fast-verdict stage
+(datapath/pipeline.py): redirect verdicts whose rules are first-bytes-
+decidable come back as inline allow/deny instead of a proxy port, so
+decided connections never touch the socket proxy.  Plain frames (and
+frames against an engine without fast verdicts) behave exactly as
+before — every L7 rule answers its redirect port.
 
 Batch padding: drained record counts round up to a power-of-two bucket
 (bounded jit cache).  Pad rows are copies of the first real record, so
@@ -63,9 +75,44 @@ from .utils.netio import recv_exact_within as _recv_exact_within
 
 MAGIC_REQ = 0xC111A901
 MAGIC_RESP = 0xC111A902
+MAGIC_REQ_PL = 0xC111A903   # records + L7 payload lane
 MAGIC_AUTH = 0xC111A9A1     # server challenge frame
 MAGIC_AUTH_OK = 0xC111A9A2  # server accept frame
 MAX_COUNT = 1 << 20
+MAX_PAYLOAD_WINDOW = 4096   # wire bound on the per-record L7 window
+
+# wire payload byte markers (match strings are ASCII, so the top two
+# byte values are free): 0xFF = -1 padding, 0xFE = -2 poison
+_PL_PAD = 0xFF
+_PL_POISON = 0xFE
+
+
+def pack_wire_payloads(strings, window: int) -> np.ndarray:
+    """Host helper: per-record L7 match strings -> the [n, window]
+    uint8 wire payload block.  None entries stay all-padding (absent
+    -> redirect); overlong strings are poisoned whole-row (the server
+    decodes them to the -2 fail-to-redirect convention)."""
+    n = len(strings)
+    out = np.full((n, window), _PL_PAD, np.uint8)
+    for i, s in enumerate(strings):
+        if s is None:
+            continue
+        b = s.encode() if isinstance(s, str) else bytes(s)
+        if len(b) > window:
+            out[i] = _PL_POISON
+        elif b:
+            out[i, :len(b)] = np.frombuffer(b, np.uint8)
+    return out
+
+
+def _decode_wire_payloads(raw: bytes, count: int,
+                          window: int) -> np.ndarray:
+    """Wire block -> the engine's [n, W] int32 payload convention."""
+    pl = np.frombuffer(raw, np.uint8).astype(np.int32)
+    pl = pl.reshape(count, window)
+    pl[pl == _PL_PAD] = -1
+    pl[pl == _PL_POISON] = -2
+    return pl
 
 # per-connection ticket pipeline depth: how many serving tickets a
 # connection keeps outstanding before blocking on the oldest — matches
@@ -186,7 +233,10 @@ class VerdictService:
                 pass
             return
         ring = PacketRing(capacity=1 << 16)
-        frames: "deque[Tuple[int, int]]" = deque()  # (frame_id, count)
+        # (frame_id, remaining count, remaining payload rows or None);
+        # the ring carries records only, so the payload lane rides
+        # this host-side queue aligned to the frame coverage
+        frames: "deque[Tuple[int, int, object]]" = deque()
         frames_lock = threading.Lock()
         eof = threading.Event()
         wake = threading.Event()
@@ -253,23 +303,41 @@ class VerdictService:
                         continue
                     # frame coverage of this drain, claimed up front
                     covers = []
+                    pl_parts = []  # (start row, payload rows)
                     off = 0
                     with frames_lock:
                         while frames and off + frames[0][1] <= n:
-                            fid, cnt = frames.popleft()
+                            fid, cnt, fpl = frames.popleft()
                             covers.append((fid, off, off + cnt, False))
+                            if fpl is not None:
+                                pl_parts.append((off, fpl[:cnt]))
                             off += cnt
                         if off != n:
                             # drain split a frame: its tail is still in
                             # the ring; stash the head
-                            fid, cnt = frames.popleft()
-                            frames.appendleft((fid, cnt - (n - off)))
+                            fid, cnt, fpl = frames.popleft()
+                            took = n - off
+                            frames.appendleft(
+                                (fid, cnt - took,
+                                 None if fpl is None else fpl[took:]))
                             covers.append((fid, off, n, True))
+                            if fpl is not None:
+                                pl_parts.append((off, fpl[:took]))
+                    payload = None
+                    if pl_parts:
+                        # assemble the drain's payload block; frames
+                        # without one stay absent (-1 -> redirect)
+                        wmax = max(b.shape[1] for _s, b in pl_parts)
+                        payload = np.full((n, wmax), -1, np.int32)
+                        for s, blk in pl_parts:
+                            payload[s:s + blk.shape[0],
+                                    :blk.shape[1]] = blk
                     # pop_batch returned fresh arrays — safe to hand
                     # to the dispatcher thread without copying
                     inflight.append(
                         (self._dispatcher.submit_records(
-                            soa, n, deadline=self.submit_deadline_s),
+                            soa, n, deadline=self.submit_deadline_s,
+                            payload=payload),
                          covers))
                     while len(inflight) >= PIPELINE_DEPTH:
                         complete_one()
@@ -295,8 +363,18 @@ class VerdictService:
                 if head is None:
                     break
                 magic, frame_id, count = struct.unpack(">III", head)
-                if magic != MAGIC_REQ or count == 0 or count > MAX_COUNT:
+                if magic not in (MAGIC_REQ, MAGIC_REQ_PL) or \
+                        count == 0 or count > MAX_COUNT:
                     break  # protocol error: drop the connection
+                window = 0
+                if magic == MAGIC_REQ_PL:
+                    whead = _recv_exact_within(sock, 4,
+                                               self.frame_timeout)
+                    if whead is None:
+                        break
+                    (window,) = struct.unpack(">I", whead)
+                    if window == 0 or window > MAX_PAYLOAD_WINDOW:
+                        break
                 # the header committed the peer to a payload: it must
                 # arrive within the frame deadline (idle BETWEEN
                 # frames stays unbounded — a healthy quiet client is
@@ -306,9 +384,16 @@ class VerdictService:
                     self.frame_timeout)
                 if raw is None:
                     break
+                fpl = None
+                if window:
+                    rawpl = _recv_exact_within(sock, count * window,
+                                               self.frame_timeout)
+                    if rawpl is None:
+                        break
+                    fpl = _decode_wire_payloads(rawpl, count, window)
                 recs = np.frombuffer(raw, PKT_HEADER_DTYPE)
                 with frames_lock:
-                    frames.append((frame_id, count))
+                    frames.append((frame_id, count, fpl))
                 pushed = 0
                 while pushed < count:
                     if dead.is_set():
@@ -406,18 +491,35 @@ class VerdictClient:
                 struct.unpack(">I", ack)[0] != MAGIC_AUTH_OK:
             raise VerdictServiceError("authentication rejected")
 
-    def classify(self, records: np.ndarray
+    def classify(self, records: np.ndarray, payloads=None
                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """``payloads`` (optional) rides the L7 fast-verdict lane: a
+        list of per-record match strings/bytes (None = absent) or a
+        pre-packed [n, W] uint8 block (pack_wire_payloads)."""
         from .native import PKT_HEADER_DTYPE
         recs = np.ascontiguousarray(records, PKT_HEADER_DTYPE)
         if len(recs) == 0:   # the server treats count=0 as a protocol
             return (np.empty(0, np.int32),   # error — short-circuit
                     np.empty(0, np.int32))
+        pl = None
+        if payloads is not None:
+            pl = payloads if isinstance(payloads, np.ndarray) else \
+                pack_wire_payloads(list(payloads), 64)
+            if pl.shape[0] != len(recs):
+                raise ValueError("payload rows != record count")
+            pl = np.ascontiguousarray(pl, np.uint8)
         with self._lock:
             fid = self._next_id
             self._next_id += 1
-            self._sock.sendall(struct.pack(">III", MAGIC_REQ, fid,
-                                           len(recs)) + recs.tobytes())
+            if pl is None:
+                self._sock.sendall(
+                    struct.pack(">III", MAGIC_REQ, fid, len(recs)) +
+                    recs.tobytes())
+            else:
+                self._sock.sendall(
+                    struct.pack(">IIII", MAGIC_REQ_PL, fid, len(recs),
+                                pl.shape[1]) +
+                    recs.tobytes() + pl.tobytes())
             head = _recv_exact(self._sock, 12)
             if head is None:
                 raise VerdictServiceError("connection closed")

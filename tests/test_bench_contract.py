@@ -56,7 +56,7 @@ def test_smoke_final_line_parses_and_fits(tmp_path):
     # per-config {value, vs_baseline} pairs
     suite = extra["suite"]
     for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
-                 "capacity", "incremental", "latency-tier",
+                 "l7-fast", "capacity", "incremental", "latency-tier",
                  "dispatch-floor", "overload", "mesh-shard",
                  "control-churn"):
         assert name in suite, f"{name} missing from compact suite"
@@ -104,6 +104,21 @@ def test_smoke_writes_full_result_file(tmp_path):
     for key in ("packed-step", "legacy-step", "reduction"):
         assert key in lc, key
     assert "reduction_floor_met" in df["extra"]
+    # the l7-fast schema is pinned: proxy-bypass rate, per-request
+    # fast vs proxy-bound percentiles per protocol, and the
+    # disabled-path byte-identity gate
+    l7 = res["extra"]["suite_configs"]["l7-fast"]
+    assert l7["unit"] == "%"
+    for key in ("bypass_rate", "decided_on_device", "programs",
+                "gate_bypass_ge_50pct", "gate_fast_p99_beats_proxy",
+                "fast_disabled_byte_identical"):
+        assert key in l7["extra"], key
+    for key in ("fast_p50_us", "fast_p99_us", "proxy_p50_us",
+                "proxy_p99_us", "p99_speedup",
+                "proxy_connections_fast_leg"):
+        assert key in l7["extra"]["http"], key
+    for key in ("fast_p50_us", "fast_p99_us", "engine_p99_us"):
+        assert key in l7["extra"]["dns"], key
     # the overload schema is pinned: per-multiplier legs with accepted
     # percentiles + shed accounting, admission vs unbounded
     ovl = res["extra"]["suite_configs"]["overload"]
@@ -203,6 +218,36 @@ def test_compact_line_keeps_gates_and_suite_when_small():
     assert out["extra"]["suite"]["broken"].startswith("failed")
     assert out["extra"]["p99_b256_us"]["host"] == 30.0
     assert out["extra"]["full"] == "BENCH_FULL_x.json"
+
+
+def test_committed_l7_fast_artifact_is_real():
+    """The committed CPU artifact must prove the tentpole's claims:
+    >=50% of the http-regex/fqdn request mix decided on device (proxy
+    bypassed), fast-path per-request p99 beating the proxy-bound
+    round trip, zero proxy connections on the fast leg, and the
+    fast-verdict-disabled pipeline byte-identical (lowered HLO)."""
+    import glob
+    found = []
+    for f in sorted(glob.glob(os.path.join(REPO, "BENCH_FULL_*.json"))):
+        try:
+            doc = json.load(open(f))
+        except (OSError, ValueError):
+            continue
+        cfg = doc.get("result", {}).get("extra", {}) \
+            .get("suite_configs", {}).get("l7-fast")
+        if isinstance(cfg, dict) and not cfg.get("extra",
+                                                 {}).get("smoke"):
+            found.append(cfg)
+    assert found, \
+        "no committed BENCH_FULL_*.json carries a real l7-fast config"
+    ex = found[-1]["extra"]
+    assert ex["bypass_rate"] >= 0.5
+    assert ex["gate_bypass_ge_50pct"] is True
+    assert ex["http"]["fast_p99_us"] < ex["http"]["proxy_p99_us"]
+    assert ex["http"]["proxy_connections_fast_leg"] == 0
+    assert ex["http"]["proxy_connections_proxy_leg"] > 0
+    assert ex["fast_disabled_byte_identical"] is True
+    assert ex["requests_per_sec"] > 0
 
 
 def test_committed_multichip_artifact_is_real():

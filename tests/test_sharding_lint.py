@@ -99,9 +99,14 @@ PACKED_STEP_WITH_FLOWS_CEILING = 10
 # v6 keeps the per-field packet batch (10 leaves) over the same
 # grouped tables/state
 V6_STEP_LEAF_CEILING = 17
+# the L7 fast-verdict stage adds exactly TWO leaves to the payload-
+# carrying step: the fused l7-dfa table group and the [B, W] payload
+# lane (the per-slot l7_prog classification rides inside ep-int32) —
+# pinned so the fast path can't silently regrow the dispatch floor
+PACKED_STEP_WITH_L7_CEILING = PACKED_STEP_LEAF_CEILING + 2
 
 
-def _loaded_engine(flows: bool = False):
+def _loaded_engine(flows: bool = False, l7_fast: bool = False):
     from bench import build_config1
     from cilium_tpu.datapath.engine import Datapath
     states, prefixes = build_config1(n_rules=10, n_endpoints=4)
@@ -110,6 +115,13 @@ def _loaded_engine(flows: bool = False):
     if flows:
         dp.enable_flow_aggregation(slots=1 << 7)
         dp.enable_provenance()
+    if l7_fast:
+        from cilium_tpu.l7.fast import (FastProgramSpec,
+                                        build_fast_programs)
+        dp.enable_l7_fast(build_fast_programs(
+            [FastProgramSpec(port=15001, protocol="http",
+                             patterns=("GET\x00/x\x00.*",))],
+            window=32))
     dp.load_policy(states, revision=1, ipcache_prefixes=prefixes)
     return dp
 
@@ -136,9 +148,25 @@ def test_jitted_step_leaf_ceiling_with_flows_and_provenance():
     assert counts["legacy-step"] >= 3 * counts["packed-step"], counts
 
 
+def test_jitted_step_leaf_ceiling_with_l7_fast():
+    """The payload-carrying step: the fused DFA group + the payload
+    lane are the ONLY new leaves, and an L7-enabled engine's manifest
+    carries the l7-dfa group (its own group, so the no-L7 program
+    keeps the exact pre-fast buffer list)."""
+    from cilium_tpu.parallel import packing
+    dp = _loaded_engine(l7_fast=True)
+    counts = dp.dispatch_leaf_counts()
+    assert counts["packed-step"] <= PACKED_STEP_WITH_L7_CEILING, counts
+    assert packing.L7_DFA_GROUP in dp._manifest4.group_names()
+    assert packing.L7_DFA_GROUP in dp._manifest6.group_names()
+    # and the no-L7 engine's manifest does NOT carry it
+    plain = _loaded_engine()
+    assert packing.L7_DFA_GROUP not in plain._manifest4.group_names()
+
+
 def test_every_packed_group_has_a_declared_spec():
     from cilium_tpu.parallel import packing
-    dp = _loaded_engine()
+    dp = _loaded_engine(l7_fast=True)
     groups = (set(dp._manifest4.group_names())
               | set(dp._manifest6.group_names())
               | {packing.CT_STATE_GROUP, packing.COUNTERS_GROUP,

@@ -42,6 +42,10 @@ HOT_MODULES = (
     "cilium_tpu/observability/slo.py",
     "cilium_tpu/observability/events.py",
     "cilium_tpu/hubble/federation.py",
+    # the L7 fast-verdict program compiler: table lowering is
+    # control-plane, but its payload-encode helpers run per serving
+    # submission — zero sync markers by construction
+    "cilium_tpu/l7/fast.py",
 )
 
 # the engine is hot only in its dispatch functions — table loading,
@@ -49,6 +53,7 @@ HOT_MODULES = (
 ENGINE_MODULE = "cilium_tpu/datapath/engine.py"
 ENGINE_HOT_FUNCS = {"process", "process6", "process_packed",
                     "_flow_step_variant", "_timestamp",
+                    "_payload_in", "_dispatch_locked",
                     "_account_dispatch", "_flush_verdict_counts",
                     "serving"}
 
