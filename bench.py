@@ -134,6 +134,33 @@ def _smoke_result():
                   "gate_bypass_ge_50pct": True,
                   "gate_fast_p99_beats_proxy": True,
                   "fast_disabled_byte_identical": True}}
+    # the threat-score config's pinned output schema: fused-scoring
+    # overhead vs the pre-threat program, the enforce-mode arm sample,
+    # the train->hot-swap push proof, and the disabled-path gate
+    suite["threat-score"] = {
+        "metric": "threat_score_verdicts_per_sec", "value": 1_150_000,
+        "unit": "verdicts/s", "vs_baseline": 0.115,
+        "extra": {"smoke": True, "batch": 65536, "rounds": 5,
+                  "baseline_vps": 1_200_000,
+                  "threat_vps": 1_150_000,
+                  "overhead_pct": 4.2,
+                  "gate_overhead_le_10pct": True,
+                  "model": {"features": 12, "hidden": 1,
+                            "resident-bytes": 92,
+                            "config": {"mode": "shadow",
+                                       "generation": 1}},
+                  "score_mean": 141.0,
+                  "enforce": {"scored": 3000, "rate_limited": 600,
+                              "redirected": 0, "dropped": 496},
+                  "hot_swap": {"push_ms": 3.1,
+                               "hot_swap_applied": True,
+                               "zero_repacks": True,
+                               "trained_flows": 4096,
+                               "generation": 2,
+                               "pre_push_batch_ms": 55.0,
+                               "post_push_batch_ms": 56.0,
+                               "no_serving_pause": True},
+                  "threat_disabled_byte_identical": True}}
     # the overload config's pinned output schema: per-multiplier legs
     # with accepted-latency percentiles + shed accounting, admission
     # control vs the unbounded pre-change queue
@@ -515,6 +542,7 @@ def run_bench():
                      "l7-fast",
                      "capacity", "incremental", "flows-overhead",
                      "tracing-overhead", "provenance-overhead",
+                     "threat-score",
                      "control-churn"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"

@@ -1001,6 +1001,191 @@ def bench_provenance_overhead(on_accel: bool):
                       for k, v in times.items()}})
 
 
+def bench_threat_score(on_accel: bool):
+    """Inline threat scoring cost + hot-swap proof: v4 full-pipeline
+    verdict throughput with the fused per-packet scorer (shadow mode,
+    flows fused on BOTH legs so the flow-table probe is real) vs the
+    pre-threat program, interleaved min-of-rounds, acceptance gate
+    <= 10% overhead on the 1000-rule config-1 policy.  Plus: (1) an
+    enforce-mode sample leg (drop + rate-limit arms live) with
+    per-outcome counts, (2) a train -> apply_threat_weights hot swap
+    performed BETWEEN timed serving batches — zero repacks asserted,
+    and the post-push batch time recorded to show no serving pause,
+    (3) the disabled-path lowered-HLO byte-identity gate."""
+    from bench import build_config1
+    from cilium_tpu.datapath.engine import Datapath, make_full_batch
+    from cilium_tpu.threat import (ThreatConfig, ThreatTrainer,
+                                   default_model)
+    from cilium_tpu.threat.stage import unpack_threat_out
+
+    states, prefixes = build_config1(n_rules=1000, n_endpoints=64)
+    batch = (1 << 20) if on_accel else (1 << 16)
+    rng = np.random.default_rng(23)
+    n_endpoints = len(states)
+
+    def make_dp(threat_cfg=None) -> Datapath:
+        dp = Datapath(ct_slots=1 << 16)
+        dp.telemetry_enabled = False
+        dp.enable_flow_aggregation(slots=1 << 12)
+        if threat_cfg is not None:
+            dp.enable_threat(default_model(threat_cfg),
+                             buckets=1 << 10)
+        dp.load_policy(states, revision=1, ipcache_prefixes=prefixes)
+        for slot in range(n_endpoints):
+            dp.set_endpoint_identity(slot, 1000 + slot)
+        return dp
+
+    n_active_flows = 8192
+    sel = rng.integers(0, n_active_flows, batch)
+    pool = {
+        "endpoint": rng.integers(0, n_endpoints, n_active_flows),
+        "saddr": rng.integers(0, 1 << 32, n_active_flows,
+                              dtype=np.uint32),
+        "daddr": rng.integers(0, 1 << 32, n_active_flows,
+                              dtype=np.uint32),
+        "sport": rng.integers(1024, 65535, n_active_flows),
+        "dport": rng.integers(1, 65536, n_active_flows),
+    }
+    pkt = make_full_batch(
+        endpoint=pool["endpoint"][sel], saddr=pool["saddr"][sel],
+        daddr=pool["daddr"][sel], sport=pool["sport"][sel],
+        dport=pool["dport"][sel], length=np.full(batch, 256))
+
+    datapaths = {}
+    clocks = {}
+    for label, cfg in (("disabled", None),
+                       ("shadow", ThreatConfig())):
+        dp = make_dp(cfg)
+        clocks[label] = 1000
+        for _ in range(8):  # settle CT/flow entries + first compiles
+            clocks[label] += 1
+            dp.process(pkt, now=clocks[label])
+        datapaths[label] = dp
+
+    iters = 8
+    rounds = 5
+    times = {"disabled": [], "shadow": []}
+    for _ in range(rounds):
+        for label, dp in datapaths.items():
+            def step():
+                clocks[label] += 1
+                v, _e, _i, _n = dp.process(pkt, now=clocks[label])
+                v.block_until_ready()
+            total, _p99 = _bench(step, iters, warmup=1)
+            times[label].append(total / iters)
+
+    base_s = float(np.min(times["disabled"]))
+    thr_s = float(np.min(times["shadow"]))
+    overhead_pct = round((thr_s - base_s) / base_s * 100, 2)
+
+    # --- train -> hot-swap push between timed serving batches --------
+    dp = datapaths["shadow"]
+    flows = dp.flow_snapshot(1 << 12)
+    trainer = ThreatTrainer(epochs=120)
+    model = trainer.fit(flows, config=ThreatConfig(generation=2)) \
+        if flows else default_model(ThreatConfig(generation=2))
+    packs_before = dp.pack_stats()["full-packs"]
+
+    def timed_batch():
+        clocks["shadow"] += 1
+        v, _e, _i, _n = dp.process(pkt, now=clocks["shadow"])
+        v.block_until_ready()
+        return v
+
+    t0 = time.perf_counter()
+    timed_batch()
+    pre_batch_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fast = dp.apply_threat_weights(model)
+    push_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    timed_batch()
+    post_batch_s = time.perf_counter() - t0
+    zero_repacks = dp.pack_stats()["full-packs"] == packs_before
+
+    # --- enforce-mode sample leg (arms live) -------------------------
+    # the shadow engine flips to enforce through set_threat_config —
+    # the leaf-write path this bench exists to prove, and no third
+    # 1000-rule engine build.  Traffic aims at installed ipcache
+    # prefixes (egress peer = daddr) so a real share of the batch
+    # policy-ALLOWS and is therefore eligible for the threat arms.
+    enf = dp
+    # restore the deterministic default weights alongside the enforce
+    # config — one more leaf-write push (the trained model's scores on
+    # this synthetic mix are its own business)
+    enf.apply_threat_weights(default_model(ThreatConfig(
+        mode="enforce", drop_score=245, ratelimit_score=170,
+        rate_per_s=1e5, burst=1 << 16, generation=3)))
+    small = 1 << 12
+    cidrs = list(prefixes)
+    hit = np.zeros(small, np.uint32)
+    for j in range(small):
+        a = cidrs[j % len(cidrs)].split("/")[0].split(".")
+        hit[j] = (int(a[0]) << 24) | (int(a[1]) << 16) | \
+            (int(a[2]) << 8) | 7
+    spkt = make_full_batch(
+        endpoint=pool["endpoint"][sel[:small]],
+        saddr=pool["saddr"][sel[:small]],
+        daddr=hit,
+        sport=pool["sport"][sel[:small]],
+        dport=pool["dport"][sel[:small]],
+        length=np.full(small, 256))
+    v, _e, _i, _n = enf.process(spkt, now=2000)
+    v.block_until_ready()
+    score, band, fired = unpack_threat_out(enf.last_threat)
+    outcome = np.where(fired & (band == 3), 3,
+                       np.where(fired & (band == 1), 1,
+                                np.where(fired & (band == 2), 2, 0)))
+    enforce_counts = {name: int((outcome == code).sum())
+                      for code, name in ((0, "scored"),
+                                         (1, "rate_limited"),
+                                         (2, "redirected"),
+                                         (3, "dropped"))}
+
+    # --- disabled-path byte identity gate ----------------------------
+    # the disabled leg doubles as the never-enabled reference; the
+    # shadow engine disables threat in place (re-jit) for the twin
+    import jax.numpy as jnp
+    lower_stage = jnp.asarray(np.zeros((10, 256), np.int32))
+    plain = datapaths["disabled"]
+    toggled = dp
+    en_txt = toggled._step_packed.lower(
+        *toggled._lower_args_packed(lower_stage)).as_text()
+    toggled.disable_threat()
+    base_txt = plain._step_packed.lower(
+        *plain._lower_args_packed(lower_stage)).as_text()
+    byte_identical = (
+        base_txt == toggled._step_packed.lower(
+            *toggled._lower_args_packed(lower_stage)).as_text()
+        and en_txt != base_txt)
+
+    thr_vps = batch / thr_s
+    return _result(
+        "threat_score_verdicts_per_sec", thr_vps, "verdicts/s",
+        10_000_000.0,
+        {"batch": batch, "rounds": rounds,
+         "baseline_vps": round(batch / base_s),
+         "threat_vps": round(thr_vps),
+         "overhead_pct": overhead_pct,
+         "gate_overhead_le_10pct": overhead_pct <= 10.0,
+         "model": datapaths["shadow"].threat_report(),
+         "score_mean": round(float(score.mean()), 1),
+         "enforce": enforce_counts,
+         "hot_swap": {
+             "push_ms": round(push_s * 1e3, 2),
+             "hot_swap_applied": bool(fast),
+             "zero_repacks": bool(zero_repacks),
+             "trained_flows": len(flows),
+             "generation": 2,
+             "pre_push_batch_ms": round(pre_batch_s * 1e3, 1),
+             "post_push_batch_ms": round(post_batch_s * 1e3, 1),
+             "no_serving_pause":
+                 post_batch_s < max(10 * pre_batch_s, pre_batch_s + 1.0)},
+         "threat_disabled_byte_identical": bool(byte_identical),
+         "round_ms": {k: [round(t * 1e3, 1) for t in v]
+                      for k, v in times.items()}})
+
+
 def bench_latency_tier(on_accel: bool):
     """The kill-the-small-batch-tail proof: per-batch-size p50/p99
     verdict completion latency, classic synchronous round trip
@@ -1961,6 +2146,7 @@ CONFIGS = {
     "flows-overhead": bench_flows_overhead,
     "tracing-overhead": bench_tracing_overhead,
     "provenance-overhead": bench_provenance_overhead,
+    "threat-score": bench_threat_score,
     "latency-tier": bench_latency_tier,
     "dispatch-floor": bench_dispatch_floor,
     "overload": bench_overload,

@@ -668,6 +668,60 @@ def cmd_trace(c: Client, args) -> int:
     return 0
 
 
+def cmd_threat(c: Client, args) -> int:
+    """``cilium-tpu threat`` — the inline threat-scoring plane:
+    status (mode/thresholds/model/verdicts), config (thresholds +
+    shadow/enforce flips, a live leaf write on the daemon), train
+    (fit from the aggregated flow plane + hot-swap push)."""
+    if args.threat_cmd == "status":
+        out = c.get("/threat")
+        if args.json:
+            _print_json(out)
+            return 0
+        mode = out.get("mode", "off")
+        print(f"Threat scoring:  {mode}")
+        if mode == "off":
+            return 0
+        if out.get("status"):
+            print(f"  {out['status']}")
+        model = out.get("model") or {}
+        cfg = model.get("config") or {}
+        print(f"  model:      gen {cfg.get('generation')}, "
+              f"{model.get('features')}x{model.get('hidden')} "
+              f"({model.get('resident-bytes')} bytes)")
+        print(f"  thresholds: drop>={cfg.get('drop-score')} "
+              f"redirect>={cfg.get('redirect-score')} "
+              f"ratelimit>={cfg.get('ratelimit-score')} "
+              f"(0 = arm off)")
+        print(f"  bucket:     rate {cfg.get('rate-per-s')}/s "
+              f"burst {cfg.get('burst')}")
+        v = out.get("verdicts") or {}
+        print("  verdicts:   " + " ".join(
+            f"{k}={v.get(k, 0)}" for k in
+            ("scored", "rate-limited", "redirected", "dropped")))
+        return 0
+    if args.threat_cmd == "config":
+        changes = {}
+        if args.mode:
+            changes["mode"] = args.mode
+        for field in ("drop_score", "redirect_score",
+                      "ratelimit_score", "redirect_port", "burst"):
+            val = getattr(args, field)
+            if val is not None:
+                changes[field] = val
+        if args.rate_per_s is not None:
+            changes["rate_per_s"] = args.rate_per_s
+        if not changes:
+            print("nothing to change (see --help)")
+            return 1
+        _print_json(c.post("/threat/config", changes))
+        return 0
+    # train
+    _print_json(c.post("/threat/train",
+                       {"max_flows": args.max_flows}))
+    return 0
+
+
 def cmd_config(c: Client, args) -> int:
     if not args.options:
         _print_json(c.get("/config"))
@@ -1043,6 +1097,38 @@ def build_parser() -> argparse.ArgumentParser:
     hs.add_argument("--aggregated", action="store_true",
                     help="include the on-device per-flow counters")
 
+    thr = sub.add_parser("threat",
+                         help="inline per-packet threat scoring "
+                              "(Taurus-style anomaly verdict plane)")
+    thr_sub = thr.add_subparsers(dest="threat_cmd", required=True)
+    ts = thr_sub.add_parser("status",
+                            help="mode, thresholds, model generation, "
+                                 "verdict accounting")
+    ts.add_argument("--json", action="store_true")
+    tc = thr_sub.add_parser(
+        "config", help="threshold + shadow/enforce updates (a live "
+                       "leaf write on the daemon; mode flips ring "
+                       "the flight recorder)")
+    tc.add_argument("--mode", choices=("shadow", "enforce"),
+                    default="")
+    tc.add_argument("--drop-score", dest="drop_score", type=int,
+                    default=None, help="score >= this drops (0 = off)")
+    tc.add_argument("--redirect-score", dest="redirect_score",
+                    type=int, default=None)
+    tc.add_argument("--ratelimit-score", dest="ratelimit_score",
+                    type=int, default=None)
+    tc.add_argument("--redirect-port", dest="redirect_port", type=int,
+                    default=None)
+    tc.add_argument("--rate-per-s", dest="rate_per_s", type=float,
+                    default=None, help="token-bucket refill rate")
+    tc.add_argument("--burst", type=int, default=None,
+                    help="token-bucket capacity")
+    tt = thr_sub.add_parser(
+        "train", help="fit from the aggregated flow plane and "
+                      "hot-swap the weights (zero repacks)")
+    tt.add_argument("--max-flows", dest="max_flows", type=int,
+                    default=4096)
+
     cfgp = sub.add_parser("config", help="daemon options")
     cfgp.add_argument("options", nargs="*", help="Option=value")
 
@@ -1151,7 +1237,7 @@ COMMANDS = {
     "status": cmd_status, "policy": cmd_policy, "endpoint": cmd_endpoint,
     "identity": cmd_identity, "service": cmd_service,
     "prefilter": cmd_prefilter, "monitor": cmd_monitor,
-    "hubble": cmd_hubble,
+    "hubble": cmd_hubble, "threat": cmd_threat,
     "config": cmd_config, "metrics": cmd_metrics,
     "trace": cmd_trace, "events": cmd_events,
     "bugtool": cmd_bugtool, "cni": cmd_cni,

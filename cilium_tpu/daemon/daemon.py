@@ -338,6 +338,21 @@ class Daemon:
         # compute_desired_policy_map_state simulations)
         if self.config.enable_provenance:
             self.datapath.enable_provenance()
+        # inline threat scoring (cilium_tpu/threat/): fuse the
+        # quantized per-packet anomaly scorer into both family
+        # pipelines.  Bootstrap weights are the hand-seeded default
+        # model; training (threat_train) hot-swaps better ones through
+        # the delta-apply path with zero repacks.
+        self._threat_trainer = None
+        if self.config.enable_threat:
+            from ..threat import ThreatTrainer, default_model
+            from ..utils.metrics import THREAT_MODEL_GENERATION
+            self._threat_trainer = ThreatTrainer()
+            model = default_model(self._threat_config_from_options())
+            self.datapath.enable_threat(
+                model, buckets=self.config.threat_buckets,
+                window_s=self.config.threat_window_s)
+            THREAT_MODEL_GENERATION.set(model.config.generation)
         self._drift_report: Optional[Dict] = None
         self._last_replay: Optional[Dict] = None
         self._drift_rng = np.random.default_rng(0xC111)
@@ -1038,6 +1053,125 @@ class Daemon:
                 "seq": recorder.last_seq,
                 "stats": recorder.stats()}
 
+    # ------------------------------------- inline threat scoring
+
+    def _threat_config_from_options(self):
+        from ..threat import ThreatConfig
+        c = self.config
+        return ThreatConfig(
+            mode=c.threat_mode,
+            drop_score=c.threat_drop_score,
+            redirect_score=c.threat_redirect_score,
+            ratelimit_score=c.threat_ratelimit_score,
+            redirect_port=c.threat_redirect_port,
+            rate_per_s=c.threat_rate_per_s, burst=c.threat_burst)
+
+    def threat_status(self) -> Dict:
+        """status()["threat"] / GET /threat: mode (off / shadow /
+        enforce), the live thresholds + model generation, and verdict
+        accounting.  An ENFORCING threat plane is a degraded-signal
+        section by design — an operator must see that a model can now
+        override policy-allowed traffic (DEGRADED_SIGNALS covers it
+        with the threat-mode/model-push flight-recorder events)."""
+        from ..utils.metrics import THREAT_VERDICTS
+        report = self.datapath.threat_report() \
+            if hasattr(self.datapath, "threat_report") else None
+        if report is None:
+            return {"mode": "off"}
+        out = {"mode": report["config"]["mode"], "model": report,
+               "verdicts": {
+                   o: int(THREAT_VERDICTS.value(labels={"outcome": o}))
+                   for o in ("scored", "rate-limited", "redirected",
+                             "dropped")}}
+        if out["mode"] == "enforce":
+            out["status"] = ("ENFORCING: threat scores can drop/"
+                             "rate-limit/redirect allowed traffic "
+                             f"(thresholds {report['config']})")
+        return out
+
+    def threat_set_config(self, **changes) -> Dict:
+        """Update the policy-controlled threat thresholds / mode (ONE
+        region write into the live packed buffer — no repack, no
+        re-jit, no serving pause).  Mode flips land in the incident
+        flight recorder: enforcement changes are exactly the kind of
+        transition an operator replays a timeline for."""
+        from dataclasses import replace as _replace
+        from ..observability.events import EVENT_THREAT_MODE
+        report = self.datapath.threat_report()
+        if report is None:
+            raise KeyError("threat scoring not enabled")
+        from ..threat import ThreatConfig
+        cur = ThreatConfig(**{k.replace("-", "_"): v for k, v in
+                              report["config"].items()
+                              if k != "generation"},
+                           generation=report["config"]["generation"])
+        allowed = {"mode", "drop_score", "redirect_score",
+                   "ratelimit_score", "redirect_port", "rate_per_s",
+                   "burst"}
+        bad = set(changes) - allowed
+        if bad:
+            raise ValueError(f"unknown threat config fields: {bad}")
+        if changes.get("mode") not in (None, "shadow", "enforce"):
+            raise ValueError("mode must be shadow|enforce")
+        new = _replace(cur, **changes)
+        self.datapath.set_threat_config(new)
+        if new.mode != cur.mode:
+            flight_recorder.record(EVENT_THREAT_MODE,
+                                   f"threat mode {cur.mode} -> "
+                                   f"{new.mode}", mode=new.mode)
+            self.monitor.notify_agent("threat-mode", new.mode)
+        return new.describe()
+
+    def threat_push_model(self, model) -> Dict:
+        """Hot-swap trained scorer weights through the delta-apply
+        leaf-write path (same-geometry pushes never repack and never
+        pause serving); bumps the generation gauge and rings the
+        flight-recorder push event."""
+        from dataclasses import replace as _replace
+        from ..observability.events import EVENT_THREAT_MODEL
+        from ..utils.metrics import THREAT_MODEL_GENERATION
+        report = self.datapath.threat_report()
+        if report is None:
+            raise KeyError("threat scoring not enabled")
+        gen = int(report["config"]["generation"]) + 1
+        model = model.with_config(
+            _replace(model.config, generation=gen))
+        fast = self.datapath.apply_threat_weights(model)
+        THREAT_MODEL_GENERATION.set(gen)
+        flight_recorder.record(EVENT_THREAT_MODEL,
+                               f"threat model generation {gen}",
+                               generation=gen, repacked=not fast)
+        return {"generation": gen, "hot-swap": bool(fast),
+                "model": model.describe()}
+
+    def threat_train(self, max_flows: int = 4096,
+                     labels: Optional[List[int]] = None) -> Dict:
+        """Fit a new scorer from the aggregated flow plane (the
+        federated per-shard drains land in the same flow snapshot
+        surface) and push it through the hot-swap path.  Returns the
+        training report + push result."""
+        if self._threat_trainer is None:
+            raise KeyError("threat scoring not enabled")
+        flows = self.datapath.flow_snapshot(max_flows)
+        if not flows and self.hubble is not None:
+            # no device flow table: fall back to the observer ring
+            flows = [{"packets": 1, "bytes": f.length or 0,
+                      "dport": f.dport, "proto": f.proto,
+                      "event": f.event,
+                      "src-identity": f.src_identity,
+                      "dst-identity": f.dst_identity,
+                      "last-seen": int(f.timestamp)}
+                     for f in self.hubble.get_flows(limit=max_flows)]
+        report = self.datapath.threat_report()
+        from ..threat import ThreatConfig
+        cfg = ThreatConfig(**{k.replace("-", "_"): v for k, v in
+                              report["config"].items()})
+        model = self._threat_trainer.fit(flows, labels=labels,
+                                         config=cfg)
+        push = self.threat_push_model(model)
+        return {"training": self._threat_trainer.last_report,
+                "push": push}
+
     # -------------------------------------------------- regeneration
 
     def _regenerate_endpoint(self, ep: Endpoint) -> None:
@@ -1530,6 +1664,11 @@ class Daemon:
             # compiled device tables and the host oracle disagree —
             # the loudest signal status() can carry
             "provenance": self._provenance_status(),
+            # inline threat scoring: mode (off/shadow/enforce), live
+            # thresholds + model generation, verdict accounting; an
+            # enforcing plane reports loudly (a model may now override
+            # policy-allowed traffic)
+            "threat": self.threat_status(),
             # runtime capability probes (bpf/run_probes.sh analog)
             "features": self._features(),
         }

@@ -158,6 +158,33 @@ class _Handler(BaseHTTPRequestHandler):
                     event_type=qs.get("type", [None])[0],
                     shard=int(shard_q) if shard_q is not None
                     else None))
+            if path == "/threat" and method == "GET":
+                # inline threat scoring: mode/thresholds/model/verdict
+                # accounting (daemon.threat_status)
+                return self._send(200, d.threat_status())
+            if path == "/threat/config" and method == "POST":
+                # threshold / shadow-enforce updates: a live leaf
+                # write, never a re-jit; mode flips ring the incident
+                # flight recorder
+                changes = json.loads(self._body() or b"{}")
+                try:
+                    return self._send(200, d.threat_set_config(
+                        **{k.replace("-", "_"): v
+                           for k, v in changes.items()}))
+                except KeyError:
+                    return self._error(404, "threat scoring disabled")
+                except (TypeError, ValueError) as e:
+                    return self._error(400, str(e))
+            if path == "/threat/train" and method == "POST":
+                # fit from the aggregated flow plane + hot-swap push
+                body = json.loads(self._body() or b"{}")
+                try:
+                    return self._send(200, d.threat_train(
+                        max_flows=int(body.get("max_flows", 4096))))
+                except KeyError:
+                    return self._error(404, "threat scoring disabled")
+                except ValueError as e:
+                    return self._error(400, str(e))
             if path == "/debug/drift-audit" and method == "POST":
                 # on-demand drift-audit sweep (the periodic
                 # controller's body): replay sampled tuples through

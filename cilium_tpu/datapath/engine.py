@@ -180,6 +180,20 @@ class Datapath:
         # redirect) per batch size, so payload-less callers of an
         # L7-enabled engine pay no per-batch allocation
         self._absent_payloads: Dict[int, np.ndarray] = {}
+        # inline threat scoring (threat/): when set, both family steps
+        # fuse the per-packet anomaly scorer — the quantized model
+        # joins the packed dispatch as its own "threat-model" group
+        # and the steps thread the shard-local ThreatState buffer
+        # (token buckets + claim-window aggregates).  None = the exact
+        # pre-threat compiled program.
+        self._threat = None               # threat/model.ThreatModel
+        self.threat_state = None          # threat/stage.ThreatState
+        self.last_threat = None           # last batch's threat_out [B]
+        self._threat_buckets = 1024
+        self._threat_window_s = 8
+        # window-aggregate update stripe (threat/stage.py): 1-in-N
+        # sampled scatters, the flow table's ls_stripe precedent
+        self._threat_stripe = 4
 
     @property
     def counters(self) -> Optional[Counters]:
@@ -277,6 +291,100 @@ class Datapath:
         with self._lock:
             progs = self._l7_fast
         return None if progs is None else progs.describe()
+
+    # -- inline threat scoring (threat/) -------------------------------------
+
+    def enable_threat(self, model, buckets: int = 1024,
+                      window_s: int = 8, stripe: int = 4) -> None:
+        """Turn on the inline threat-scoring stage: both family steps
+        fuse the quantized per-packet anomaly scorer (threat/stage.py)
+        over the flow-table probe + the shard-local ThreatState
+        buffer.  ``model`` is a threat/model.ThreatModel; its config
+        (thresholds, shadow/enforce) is traced as VALUES, so later
+        flips go through set_threat_config without a re-jit."""
+        from ..threat.stage import make_threat_state
+        with self._lock:
+            self._threat = model
+            self._threat_buckets = buckets
+            self._threat_window_s = window_s
+            self._threat_stripe = stripe
+            self.threat_state = make_threat_state(buckets)
+            if self._replicated_sharding is not None:
+                self.threat_state = jax.device_put(
+                    self.threat_state, self._replicated_sharding)
+            if self._step is not None:
+                self._rebuild()
+
+    def disable_threat(self) -> None:
+        """Back to the exact pre-threat compiled program."""
+        with self._lock:
+            if self._threat is None:
+                return
+            self._threat = None
+            self.threat_state = None
+            self.last_threat = None
+            if self._step is not None:
+                self._rebuild()
+
+    def set_threat_config(self, config) -> None:
+        """Swap the policy-controlled threshold/mode vector (a
+        threat/model.ThreatConfig): ONE region write into the live
+        threat-model group buffer — a shadow<->enforce flip or a
+        threshold change never repacks and never re-jits."""
+        with self._lock:
+            if self._threat is None:
+                raise RuntimeError("threat scoring not enabled")
+            self._threat = self._threat.with_config(config)
+            cfg = jnp.asarray(self._threat.config.encode())
+            if self._tables is not None:
+                self._tables = self._tables._replace(tm_cfg=cfg)
+                if self._tables6 is not None:
+                    self._tables6 = self._tables6._replace(tm_cfg=cfg)
+                self._write_leaf_locked("tm_cfg", cfg)
+
+    def apply_threat_weights(self, model) -> bool:
+        """Hot-swap the scorer weights (a trained ThreatModel):
+        same-geometry pushes are region writes into the threat-model
+        group — zero repacks, no serving pause (the delta-apply
+        write-through path).  A geometry change (different hidden
+        width) rebuilds.  Returns True when the fast path applied."""
+        with self._lock:
+            if self._threat is None:
+                raise RuntimeError("threat scoring not enabled")
+            fast = model.geometry == self._threat.geometry and \
+                self._tables is not None
+            self._threat = model
+            if not fast:
+                if self._step is not None:
+                    self._rebuild()
+                return False
+            leaves = {k: jnp.asarray(v)
+                      for k, v in model.tables().items()}
+            self._tables = self._tables._replace(**leaves)
+            if self._tables6 is not None:
+                self._tables6 = self._tables6._replace(**leaves)
+            for path, arr in leaves.items():
+                self._write_leaf_locked(path, arr)
+            return True
+
+    def threat_report(self) -> Optional[Dict]:
+        """Model + state report (status surfaces; None = disabled)."""
+        with self._lock:
+            model = self._threat
+            state = self.threat_state
+            buckets = self._threat_buckets
+            window_s = self._threat_window_s
+        if model is None:
+            return None
+        out = dict(model.describe())
+        out.update({"buckets": buckets, "window-s": window_s,
+                    "shard": self.shard_index})
+        if state is not None:
+            from ..threat.stage import COL_WIN_TS
+            st = np.asarray(state.state)
+            out["active-buckets"] = int(
+                (st[:-1, COL_WIN_TS] != 0).sum())
+        return out
 
     def l7_fast_window(self) -> int:
         """The payload window W callers must encode to (0 = fast
@@ -394,6 +502,8 @@ class Datapath:
             self.flows.state = jax.device_put(self.flows.state, rep)
         if self._counters is not None:
             self._counters = jax.device_put(self._counters, rep)
+        if self.threat_state is not None:
+            self.threat_state = jax.device_put(self.threat_state, rep)
 
     # -- table loading -------------------------------------------------------
 
@@ -722,12 +832,29 @@ class Datapath:
                 l7_pmask=jnp.asarray(progs.pmask))
             l7_static = dict(with_l7_fast=1, l7_k=progs.k,
                              l7_c1=progs.c1)
+        # inline threat scoring: the quantized model leaves join both
+        # family tables (their own threat-model pack group); omitted
+        # entirely when disabled so the pre-threat program stays
+        # byte-identical
+        threat_kwargs = {}
+        threat_static = {}
+        if self._threat is not None:
+            threat_kwargs = {k: jnp.asarray(v)
+                             for k, v in self._threat.tables().items()}
+            threat_static = dict(with_threat=1,
+                                 threat_window_s=self._threat_window_s,
+                                 threat_stripe=self._threat_stripe)
+            if self.threat_state is None:
+                from ..threat.stage import make_threat_state
+                self.threat_state = make_threat_state(
+                    self._threat_buckets)
         self._tables = FullTables(
             datapath=dp, lb=self.lb.compiled.tables,
             pf_masks=jnp.asarray(pf.masks), pf_key_a=jnp.asarray(pf.key_a),
             pf_key_b=jnp.asarray(pf.key_b), pf_value=jnp.asarray(pf.value),
             pf_plens=jnp.asarray(pf.prefix_lens),
-            ep_identity=ep_ident, **tun_kwargs, **l7_kwargs)
+            ep_identity=ep_ident, **tun_kwargs, **l7_kwargs,
+            **threat_kwargs)
         if self._counters is None or self._counters.shape[1] != n:
             self._counters = make_counter_pack(n)
         flow_kwargs = {}
@@ -754,7 +881,8 @@ class Datapath:
             lb_probe=self.lb.compiled.max_probe,
             ct_slots=self.ct.slots, ct_probe=self.ct.max_probe,
             tun_probe=tun_probe)
-        self._statics4 = {**v4_static, **flow_kwargs, **l7_static}
+        self._statics4 = {**v4_static, **flow_kwargs, **l7_static,
+                          **threat_static}
 
         # v6 twin: shares the (family-agnostic) policy tensors, runs
         # the 4-word LPMs for prefilter/ipcache and its own CT table.
@@ -769,14 +897,15 @@ class Datapath:
             ipcache6=lpm6_tables(ipc6), pf6=lpm6_tables(pf6),
             lb6=lb6.tables if lb6 is not None else None,
             router_ip6=self._router_ip6, ep_identity=ep_ident,
-            **l7_kwargs)
+            **l7_kwargs, **threat_kwargs)
         v6_static = dict(
             policy_probe=policy_probe,
             lpm6_probe=max(1, ipc6.max_probe),
             pf6_probe=max(1, pf6.max_probe),
             ct_slots=self.ct6.slots, ct_probe=self.ct6.max_probe,
             lb6_probe=lb6.max_probe if lb6 is not None else 0)
-        self._statics6 = {**v6_static, **flow_kwargs, **l7_static}
+        self._statics6 = {**v6_static, **flow_kwargs, **l7_static,
+                          **threat_static}
 
         # mesh placement: commit every table onto this shard's column
         # submesh so the jitted steps compile as submesh-resident SPMD
@@ -786,6 +915,9 @@ class Datapath:
             self._tables = jax.device_put(self._tables, rep)
             self._tables6 = jax.device_put(self._tables6, rep)
             self._counters = jax.device_put(self._counters, rep)
+            if self.threat_state is not None:
+                self.threat_state = jax.device_put(self.threat_state,
+                                                   rep)
 
         # pack the table leaf zoo into the grouped dispatch buffers
         # (the dispatch-floor fix): every jitted step below takes the
@@ -795,13 +927,13 @@ class Datapath:
 
         def grouped(step_fn, unpack, statics):
             def g(tbufs, ct, counters, batch, now, flows=None,
-                  payload=None):
+                  payload=None, threat=None):
                 tables = unpack(tbufs)
-                if flows is None and payload is None:
+                if flows is None and payload is None and threat is None:
                     return step_fn(tables, ct, counters, batch, now,
                                    **statics)
                 return step_fn(tables, ct, counters, batch, now,
-                               flows, payload, **statics)
+                               flows, payload, threat, **statics)
             return jax.jit(g, donate_argnums=(1, 2))
 
         from ..parallel import packing
@@ -875,21 +1007,25 @@ class Datapath:
             flows = () if self.flows is None else (self.flows.state,)
             payload = () if self._l7_fast is None else (
                 np.zeros((1, self._l7_fast.window), np.int32),)
+            threat = () if self._threat is None else \
+                (self.threat_state,)
             packed_args = (self._tbufs4, self.ct.state, self._counters,
                            np.zeros((10, 1), np.int32), 0) + flows \
-                + payload
+                + payload + threat
             n_packed = len(tree_leaves(packed_args))
             # v6 keeps the per-field packet batch (10 leaves) but the
             # same grouped tables/state
             n_v6 = (len(tree_leaves((self._tbufs6, self.ct6.state,
                                      self._counters))) + 10 + 1
                     + len(tree_leaves(flows))
-                    + len(tree_leaves(payload)))
+                    + len(tree_leaves(payload))
+                    + len(tree_leaves(threat)))
             # the legacy-pytree equivalent: raw table leaves + per-leaf
             # CT state + per-leaf counters + batch + timestamp
             n_legacy = (len(tree_leaves(self._tables)) + 8 + 2 + 1 + 1
                         + len(tree_leaves(flows))
-                        + len(tree_leaves(payload)))
+                        + len(tree_leaves(payload))
+                        + len(tree_leaves(threat)))
             return {"packed-step": n_packed,
                     "v6-step": n_v6,
                     "legacy-step": n_legacy,
@@ -902,9 +1038,14 @@ class Datapath:
         matrix stands in, as for payload-less dispatch)."""
         args = (self._tbufs4, self.ct.state, self._counters, packed,
                 jnp.int32(now))
+        pl = None
         if self._l7_fast is not None:
-            args = args + (None, jnp.asarray(
-                self._payload_in(None, int(packed.shape[1]))))
+            pl = jnp.asarray(
+                self._payload_in(None, int(packed.shape[1])))
+        if self._threat is not None:
+            return args + (None, pl, self.threat_state)
+        if pl is not None:
+            return args + (None, pl)
         return args
 
     # -- the hot path --------------------------------------------------------
@@ -950,10 +1091,13 @@ class Datapath:
         return cached
 
     def _dispatch_locked(self, step, tbufs, ct_state, batch, ts,
-                         flows_in, payload):
-        """One jitted-step call with the optional flows/payload lanes
-        threaded positionally (lock held).  Call shapes stay stable
-        per configuration, so the jit cache sees one entry."""
+                         flows_in, payload, threat=None):
+        """One jitted-step call with the optional flows/payload/threat
+        lanes threaded positionally (lock held).  Call shapes stay
+        stable per configuration, so the jit cache sees one entry."""
+        if threat is not None:
+            return step(tbufs, ct_state, self._counters, batch, ts,
+                        flows_in, payload, threat)
         if payload is not None:
             return step(tbufs, ct_state, self._counters, batch, ts,
                         flows_in, payload)
@@ -992,13 +1136,18 @@ class Datapath:
                 flows_in = None
             outs = self._dispatch_locked(step, self._tbufs4,
                                          self.ct.state, pkt, ts,
-                                         flows_in, pl)
+                                         flows_in, pl,
+                                         self.threat_state)
             verdict, event, identity, nat = outs[:4]
             self.ct.state, self._counters = outs[4], outs[5]
             tail = 6
             if self.flows is not None:
                 self.flows.state = outs[tail]
                 tail += 1
+            if self._threat is not None:
+                self.threat_state = outs[tail]
+                self.last_threat = outs[tail + 1]
+                tail += 2
             if self.provenance_enabled:
                 self.last_provenance = Provenance(outs[tail],
                                                   outs[tail + 1])
@@ -1033,13 +1182,18 @@ class Datapath:
                 flows_in = None
             outs = self._dispatch_locked(step, self._tbufs6,
                                          self.ct6.state, pkt, ts,
-                                         flows_in, pl)
+                                         flows_in, pl,
+                                         self.threat_state)
             verdict, event, identity, nat = outs[:4]
             self.ct6.state, self._counters = outs[4], outs[5]
             tail = 6
             if self.flows is not None:
                 self.flows.state = outs[tail]
                 tail += 1
+            if self._threat is not None:
+                self.threat_state = outs[tail]
+                self.last_threat = outs[tail + 1]
+                tail += 2
             if self.provenance_enabled:
                 self.last_provenance = Provenance(outs[tail],
                                                   outs[tail + 1])
@@ -1087,13 +1241,18 @@ class Datapath:
                 flows_in = None
             outs = self._dispatch_locked(step, self._tbufs4,
                                          self.ct.state, packed, ts,
-                                         flows_in, pl)
+                                         flows_in, pl,
+                                         self.threat_state)
             verdict, event, identity, nat = outs[:4]
             self.ct.state, self._counters = outs[4], outs[5]
             tail = 6
             if self.flows is not None:
                 self.flows.state = outs[tail]
                 tail += 1
+            if self._threat is not None:
+                self.threat_state = outs[tail]
+                self.last_threat = outs[tail + 1]
+                tail += 2
             if self.provenance_enabled:
                 self.last_provenance = Provenance(outs[tail],
                                                   outs[tail + 1])

@@ -27,6 +27,10 @@ DROP_PREFILTER = -133       # XDP prefilter (bpf_xdp.c check_filters)
 DROP_POLICY_L7 = -134
 DROP_INVALID = -135
 DROP_UNKNOWN_TARGET = -136  # icmp6.h ACTION_UNKNOWN_ICMP6_NS analog
+DROP_THREAT = -137          # inline threat scoring (threat/stage.py):
+#                             the anomaly score crossed the drop
+#                             threshold, or the rate-limit arm's token
+#                             bucket ran dry — enforce mode only
 
 DROP_NAMES = {
     DROP_POLICY: "Policy denied (L3/L4)",
@@ -36,6 +40,7 @@ DROP_NAMES = {
     DROP_POLICY_L7: "Policy denied (L7)",
     DROP_INVALID: "Invalid packet",
     DROP_UNKNOWN_TARGET: "Unknown ICMPv6 ND target",
+    DROP_THREAT: "Threat score denied (inline ML)",
 }
 
 TRACE_NAMES = {
@@ -83,6 +88,14 @@ TIER_LB = 7              # answered by the local service tier (ICMPv6
 # kafka, body) and truncated/absent payloads keep TIER_L7_REDIRECT.
 TIER_L7_FAST_ALLOW = 8   # DFA matched: allowed inline on device
 TIER_L7_FAST_DENY = 9    # DFA refused: denied inline (DROP_POLICY_L7)
+# Inline threat scoring (threat/stage.py): the fused anomaly scorer
+# overrode an allow-or-redirect verdict in enforce mode.  Shadow-mode
+# scoring never re-tiers (verdicts are bit-exact pre-threat), and a
+# rate-limit-band packet that passed (token available / prand spared
+# it) keeps its original tier — only actual overrides re-attribute.
+TIER_THREAT_DROP = 10       # score >= drop threshold -> DROP_THREAT
+TIER_THREAT_RATELIMIT = 11  # rate-limit arm: bucket dry + prand drop
+TIER_THREAT_REDIRECT = 12   # score >= redirect threshold -> proxy
 
 TIER_NAMES = {
     TIER_NONE: "none",
@@ -95,6 +108,9 @@ TIER_NAMES = {
     TIER_LB: "lb",
     TIER_L7_FAST_ALLOW: "l7-fast-allow",
     TIER_L7_FAST_DENY: "l7-fast-deny",
+    TIER_THREAT_DROP: "threat-drop",
+    TIER_THREAT_RATELIMIT: "threat-ratelimit",
+    TIER_THREAT_REDIRECT: "threat-redirect",
 }
 
 

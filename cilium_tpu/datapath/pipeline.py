@@ -192,6 +192,16 @@ class FullTables(NamedTuple):
     l7_accept: jnp.ndarray = None     # [S] 0/1 per-state accept
     l7_starts: jnp.ndarray = None     # [R] per-regex start state
     l7_pmask: jnp.ndarray = None      # [P, R] program -> regex rows
+    # Inline threat-scoring model (threat/model.ThreatModel.tables()):
+    # the quantized Q8.8 scorer weights + the policy-controlled
+    # threshold/mode config vector, packed as their own "threat-model"
+    # dispatch group.  All None = threat scoring disabled (compiled
+    # program byte-identical to the pre-threat step).
+    tm_w1: jnp.ndarray = None         # [F, H] int32 layer-1 weights
+    tm_b1: jnp.ndarray = None         # [H] int32 layer-1 bias
+    tm_w2: jnp.ndarray = None         # [H] int32 layer-2 weights
+    tm_b2: jnp.ndarray = None         # [1] int32 layer-2 bias
+    tm_cfg: jnp.ndarray = None        # [8] int32 thresholds/mode/gen
 
 
 def _flow_identities(ep_identity, endpoint, peer_identity, direction):
@@ -277,7 +287,8 @@ def host_fail_static_step(soa, n: int, *, established, identity_of,
 
 def full_datapath_step_packed(tables: FullTables, ct,
                               counters: Counters, packed, now,
-                              flows=None, payload=None, **statics):
+                              flows=None, payload=None, threat=None,
+                              **statics):
     """full_datapath_step over ONE [10, B] int32 field matrix.
 
     The latency-tier fix for small-batch dispatch overhead: ten
@@ -292,7 +303,7 @@ def full_datapath_step_packed(tables: FullTables, ct,
     pkt = FullPacketBatch(**{f: packed[i]
                              for i, f in enumerate(PACKED_FIELDS)})
     return full_datapath_step(tables, ct, counters, pkt, now,
-                              flows, payload, **statics)
+                              flows, payload, threat, **statics)
 
 
 def _l7_fast_stage(tables, payload, pol_verdict, pol_slot, *,
@@ -350,7 +361,7 @@ def _l7_fast_stage(tables, payload, pol_verdict, pol_slot, *,
 
 def full_datapath_step(tables: FullTables, ct, counters: Counters,
                        pkt: FullPacketBatch, now: jnp.ndarray,
-                       flows=None, payload=None, *,
+                       flows=None, payload=None, threat=None, *,
                        policy_probe: int, lpm_probe: int, pf_probe: int,
                        lb_probe: int, ct_slots: int, ct_probe: int,
                        tun_probe: int = 0, flow_slots: int = 0,
@@ -358,7 +369,9 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                        flow_claim_budget: int = 1024,
                        with_provenance: int = 0,
                        with_l7_fast: int = 0, l7_k: int = 1,
-                       l7_c1: int = 2):
+                       l7_c1: int = 2, with_threat: int = 0,
+                       threat_window_s: int = 8,
+                       threat_stripe: int = 4):
     """The batched equivalent of the reference's per-packet egress path
     (bpf_lxc.c:432 handle_ipv4_from_lxc): XDP prefilter drop, service
     DNAT (lb4_local), conntrack lookup, ipcache identity resolve, policy
@@ -384,13 +397,25 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
     back to redirect-to-proxy for truncated/absent payloads.  0 keeps
     the compiled program byte-identical to the pre-fast step (the
     payload arg is never passed then).
+
+    ``with_threat`` (static) fuses the inline threat-scoring stage
+    (threat/stage.py): every packet gets an anomaly score from the
+    flow-table probe + the claim-window aggregates in ``threat`` (the
+    shard-local ThreatState buffer, returned updated) + its own tuple
+    features; in enforce mode the score maps through the
+    policy-controlled thresholds (tables.tm_cfg) to drop
+    (VERDICT_DROP_THREAT), redirect-to-proxy, or token-bucket
+    rate-limit, and NEVER overrides an existing drop.  Appends
+    (threat', threat_out [B]) outputs.  0 keeps the compiled program
+    byte-identical to the pre-threat step.
     """
     from .conntrack import CT_NEW, CTBatch, ct_step
     from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_POLICY_L7,
-                         DROP_PREFILTER, TRACE_TO_LXC, TRACE_TO_PROXY)
+                         DROP_PREFILTER, DROP_THREAT, TRACE_TO_LXC,
+                         TRACE_TO_PROXY)
     from .lb import lb_step
     from .verdict import (VERDICT_ALLOW, VERDICT_DROP, VERDICT_DROP_FRAG,
-                          VERDICT_DROP_L7)
+                          VERDICT_DROP_L7, VERDICT_DROP_THREAT)
 
     # 1. Prefilter (bpf_xdp.c:158 check_filters).
     if tables.pf_key_a.shape[0] > 0:
@@ -480,6 +505,27 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
         pf_hit, jnp.int32(VERDICT_DROP),
         jnp.where(established, ct_proxy, pol_verdict))
 
+    # 7.5 Inline threat scoring (threat/stage.py): per-packet anomaly
+    # score from the flow-table probe + window aggregates + tuple
+    # features; enforce-mode arms override allow/redirect verdicts
+    # BEFORE the event/overlay stages so a threat-dropped packet never
+    # encaps and a threat-redirect routes to the proxy like any other.
+    if with_threat:
+        from ..threat.stage import threat_stage
+        t_src, t_dst = _flow_identities(tables.ep_identity,
+                                        pkt.endpoint, identity,
+                                        pkt.direction)
+        verdict, threat, threat_out, thr_drop, thr_redir, rl_drop = \
+            threat_stage(
+                tables, threat, flows, verdict,
+                identity=identity, dport=dport, proto=pkt.proto,
+                tcp_flags=pkt.tcp_flags, length=pkt.length,
+                is_fragment=pkt.is_fragment, established=established,
+                saddr_w=pkt.saddr, daddr_w=daddr, sport=pkt.sport,
+                flow_src=t_src, flow_dst=t_dst, now=now,
+                window_s=threat_window_s, flow_slots=flow_slots,
+                flow_probe=flow_probe, stripe=threat_stripe)
+
     # 8. Reply-path reverse NAT (lb.h lb4_rev_nat): restore VIP/port on
     # packets of flows whose CT entry carries a rev-NAT index.
     from .conntrack import CT_REPLY, CT_RELATED
@@ -499,6 +545,10 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
         # final verdict identifies inline L7 denials exactly
         event = jnp.where(verdict == jnp.int32(VERDICT_DROP_L7),
                           jnp.int32(DROP_POLICY_L7), event)
+    if with_threat:
+        # VERDICT_DROP_THREAT likewise names the threat stage exactly
+        event = jnp.where(verdict == jnp.int32(VERDICT_DROP_THREAT),
+                          jnp.int32(DROP_THREAT), event)
 
     # 9. Overlay encap (encap.h encap_and_redirect): allowed egress
     # packets whose (DNAT'd) destination falls in a peer node's pod
@@ -544,6 +594,11 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
             pkt.length, now, slots=flow_slots, max_probe=flow_probe,
             claim_budget=flow_claim_budget)
         out = out + (flows,)
+    if with_threat:
+        # 10.5 Threat outputs: the updated shard-local state buffer
+        # and the per-packet score|band|fired lane (engine keeps the
+        # last batch's lane for the observability consumers)
+        out = out + (threat, threat_out)
     if with_provenance:
         # 11. Provenance finalization: mirror the final-verdict
         # precedence (step 7) — prefilter beats everything, CT
@@ -564,6 +619,20 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
             jnp.where(established, jnp.int32(TIER_CT_ESTABLISHED),
                       pol_tier))
         slot = jnp.where(pf_hit | established, jnp.int32(-1), pol_slot)
+        if with_threat:
+            # the threat stage decided last: where it overrode the
+            # verdict, it owns the tier (the slot keeps the matched
+            # policy attribution — the rule that ALLOWED the traffic
+            # the scorer then refused)
+            from .events import (TIER_THREAT_DROP,
+                                 TIER_THREAT_RATELIMIT,
+                                 TIER_THREAT_REDIRECT)
+            tier = jnp.where(
+                rl_drop, jnp.int32(TIER_THREAT_RATELIMIT),
+                jnp.where(thr_drop, jnp.int32(TIER_THREAT_DROP),
+                          jnp.where(thr_redir,
+                                    jnp.int32(TIER_THREAT_REDIRECT),
+                                    tier)))
         out = out + (slot, tier)
     return out
 
@@ -665,6 +734,14 @@ class FullTables6(NamedTuple):
     l7_accept: jnp.ndarray = None
     l7_starts: jnp.ndarray = None
     l7_pmask: jnp.ndarray = None
+    # Inline threat-scoring model (shared with the v4 family — flow
+    # keys and features are identity-based, family-agnostic); all
+    # None = threat scoring disabled
+    tm_w1: jnp.ndarray = None
+    tm_b1: jnp.ndarray = None
+    tm_w2: jnp.ndarray = None
+    tm_b2: jnp.ndarray = None
+    tm_cfg: jnp.ndarray = None
 
 
 def lpm6_tables(c) -> LPM6Tables:
@@ -685,7 +762,7 @@ def fold6(words: jnp.ndarray) -> jnp.ndarray:
 
 def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                         pkt: FullPacketBatch6, now: jnp.ndarray,
-                        flows=None, payload=None, *,
+                        flows=None, payload=None, threat=None, *,
                         policy_probe: int, lpm6_probe: int,
                         pf6_probe: int, ct_slots: int, ct_probe: int,
                         lb6_probe: int = 0, flow_slots: int = 0,
@@ -693,7 +770,9 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                         flow_claim_budget: int = 1024,
                         with_provenance: int = 0,
                         with_l7_fast: int = 0, l7_k: int = 1,
-                        l7_c1: int = 2):
+                        l7_c1: int = 2, with_threat: int = 0,
+                        threat_window_s: int = 8,
+                        threat_stripe: int = 4):
     """The v6 twin of full_datapath_step (bpf_lxc.c:745 ipv6_policy):
     prefilter drop, service DNAT (lb6_local), conntrack, ipcache
     identity, policy verdict for CT_NEW flows, CT create gated on the
@@ -707,12 +786,13 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
     from ..ops.lpm_ops import lpm6_lookup
     from .conntrack import CT_NEW, CTBatch, ct_step
     from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_POLICY_L7,
-                         DROP_PREFILTER, DROP_UNKNOWN_TARGET,
-                         ICMP6_ECHO_REPLY, ICMP6_NS_REPLY, TRACE_TO_LXC,
-                         TRACE_TO_PROXY)
+                         DROP_PREFILTER, DROP_THREAT,
+                         DROP_UNKNOWN_TARGET, ICMP6_ECHO_REPLY,
+                         ICMP6_NS_REPLY, TRACE_TO_LXC, TRACE_TO_PROXY)
     from .lb import lb6_rev_nat, lb6_step
     from .verdict import (VERDICT_DROP, VERDICT_DROP_FRAG,
-                          VERDICT_DROP_L7, verdict_step)
+                          VERDICT_DROP_L7, VERDICT_DROP_THREAT,
+                          verdict_step)
 
     b = pkt.sport.shape[0]
 
@@ -827,6 +907,27 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                             jnp.where(established, ct_proxy,
                                       pol_verdict))))
 
+    # 6.5 Inline threat scoring (same fused stage as the v4 family;
+    # addresses enter the tuple hash as their CT folds).  Locally
+    # answered ICMPv6 rows are scored but exempt from overrides — the
+    # responder's reply is synthesized, not forwarded.
+    if with_threat:
+        from ..threat.stage import threat_stage
+        t_src, t_dst = _flow_identities(tables.ep_identity,
+                                        pkt.endpoint, identity,
+                                        pkt.direction)
+        verdict, threat, threat_out, thr_drop, thr_redir, rl_drop = \
+            threat_stage(
+                tables, threat, flows, verdict,
+                identity=identity, dport=dport, proto=pkt.proto,
+                tcp_flags=pkt.tcp_flags, length=pkt.length,
+                is_fragment=pkt.is_fragment, established=established,
+                saddr_w=ctb.saddr, daddr_w=ctb.daddr, sport=pkt.sport,
+                flow_src=t_src, flow_dst=t_dst, now=now,
+                window_s=threat_window_s, flow_slots=flow_slots,
+                flow_probe=flow_probe, stripe=threat_stripe,
+                exempt=icmp6_handled)
+
     # 7. Reply-path reverse NAT (lb6_rev_nat).
     from .conntrack import CT_RELATED, CT_REPLY
     is_reply = (ct_verdict == CT_REPLY) | (ct_verdict == CT_RELATED)
@@ -851,6 +952,9 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
     if with_l7_fast:
         event = jnp.where(verdict == jnp.int32(VERDICT_DROP_L7),
                           jnp.int32(DROP_POLICY_L7), event)
+    if with_threat:
+        event = jnp.where(verdict == jnp.int32(VERDICT_DROP_THREAT),
+                          jnp.int32(DROP_THREAT), event)
     nat = NAT6Result(daddr=daddr, dport=dport, saddr=nat_saddr,
                      sport=nat_sport, rev_nat=ct_rev_nat)
     out = (verdict, event, identity, nat, ct, counters)
@@ -868,6 +972,8 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
             pkt.length, now, slots=flow_slots, max_probe=flow_probe,
             claim_budget=flow_claim_budget)
         out = out + (flows,)
+    if with_threat:
+        out = out + (threat, threat_out)
     if with_provenance:
         # Provenance finalization, mirroring the v6 verdict
         # precedence: prefilter, then the local ICMPv6 responder
@@ -889,5 +995,15 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                                 pol_tier)))
         slot = jnp.where(pf_hit | icmp6_handled | established,
                          jnp.int32(-1), pol_slot)
+        if with_threat:
+            from .events import (TIER_THREAT_DROP,
+                                 TIER_THREAT_RATELIMIT,
+                                 TIER_THREAT_REDIRECT)
+            tier = jnp.where(
+                rl_drop, jnp.int32(TIER_THREAT_RATELIMIT),
+                jnp.where(thr_drop, jnp.int32(TIER_THREAT_DROP),
+                          jnp.where(thr_redir,
+                                    jnp.int32(TIER_THREAT_REDIRECT),
+                                    tier)))
         out = out + (slot, tier)
     return out

@@ -32,6 +32,11 @@ VERDICT_DROP_FRAG = -2  # DROP_FRAG_NOSUPPORT analog
 VERDICT_DROP_L7 = -3    # DROP_POLICY_L7 analog: denied inline by the
 #                         on-device L7 fast-verdict stage (the matched
 #                         key carried a proxy port, the payload decided)
+VERDICT_DROP_THREAT = -4  # DROP_THREAT analog: the inline threat-
+#                           scoring stage (threat/stage.py) denied —
+#                           either the drop arm or a rate-limit
+#                           token-bucket drop; only ever produced in
+#                           enforce mode on traffic policy allowed
 VERDICT_ALLOW = 0       # TC_ACT_OK; >0 == proxy redirect port
 
 

@@ -23,7 +23,8 @@ from .datapath.events import (DROP_NAMES, TIER_L7_FAST_ALLOW,
                               TRACE_NAMES, format_denied_key)
 from .utils.metrics import (DROP_COUNT, FORWARD_COUNT,
                             L7_FAST_VERDICTS, POLICY_RULE_DROPS,
-                            POLICY_VERDICT_TIERS)
+                            POLICY_VERDICT_TIERS, THREAT_SCORES,
+                            THREAT_VERDICTS)
 
 # label-cardinality guard: at most this many DISTINCT denied keys are
 # admitted into the per-rule drop counter per ingested batch (the
@@ -109,7 +110,8 @@ class MonitorHub:
 
     def ingest_batch(self, event_codes, endpoints, identities, dports,
                      protos, lengths, tiers=None, match_slots=None,
-                     rule_of=None, l7_proto_of=None) -> None:
+                     rule_of=None, l7_proto_of=None,
+                     threat_out=None) -> None:
         """Aggregate one datapath batch (all args array-like [B]).
 
         ``tiers``/``match_slots`` are the engine's per-packet
@@ -120,7 +122,11 @@ class MonitorHub:
         ``l7_proto_of`` (Datapath.l7_fast_protocol_of) maps a match
         slot to its fast program's protocol tag so rows decided by the
         on-device L7 fast-verdict stage feed
-        ``l7_fast_verdicts_total{protocol,outcome}``."""
+        ``l7_fast_verdicts_total{protocol,outcome}``.
+
+        ``threat_out`` is the engine's packed per-packet threat lane
+        (Datapath.last_threat: score | band<<8 | fired): feeds
+        ``threat_verdicts_total{outcome}`` and the score histogram."""
         codes = np.asarray(event_codes)
         eps = np.asarray(endpoints)
         ids = np.asarray(identities)
@@ -147,6 +153,8 @@ class MonitorHub:
                 POLICY_VERDICT_TIERS.inc(n, labels={
                     "tier": TIER_NAMES.get(tier, str(tier))})
             self._count_l7_fast(trs, slots, l7_proto_of)
+        if threat_out is not None:
+            self._count_threat(np.asarray(threat_out))
         rule_drops = self._aggregate_rule_drops(codes, ids, dps, prs,
                                                 slots, rule_of) \
             if trs is not None else {}
@@ -219,6 +227,27 @@ class MonitorHub:
                 proto = l7_proto_of(int(slot)) or "unknown"
                 L7_FAST_VERDICTS.inc(int(n), labels={
                     "protocol": proto, "outcome": outcome})
+
+    @staticmethod
+    def _count_threat(out: np.ndarray) -> None:
+        """Decode one batch's packed threat lane into outcome counts
+        + the score histogram (grouped by distinct score so a big
+        batch costs at most 256 histogram touches)."""
+        from .threat.stage import unpack_threat_out
+        score, band, fired = unpack_threat_out(out)
+        outcome = np.where(
+            fired & (band == 3), 3,
+            np.where(fired & (band == 1), 1,
+                     np.where(fired & (band == 2), 2, 0)))
+        names = {0: "scored", 1: "rate-limited", 2: "redirected",
+                 3: "dropped"}
+        for code, n in zip(*map(np.ndarray.tolist,
+                                np.unique(outcome,
+                                          return_counts=True))):
+            THREAT_VERDICTS.inc(n, labels={"outcome": names[code]})
+        for val, n in zip(*map(np.ndarray.tolist,
+                               np.unique(score, return_counts=True))):
+            THREAT_SCORES.observe_many(float(val), n)
 
     @staticmethod
     def _aggregate_rule_drops(codes, ids, dps, prs, slots,

@@ -64,6 +64,8 @@ EVENT_KVSTORE_RECOVERED = "kvstore-recovered"
 EVENT_DRIFT_AUDIT = "drift-audit"
 EVENT_CONTROLLER_FAILING = "controller-failing"
 EVENT_MAP_PRESSURE = "map-pressure-warning"
+EVENT_THREAT_MODE = "threat-mode"
+EVENT_THREAT_MODEL = "threat-model-push"
 
 EVENT_TYPES: Dict[str, str] = {
     EVENT_DATAPLANE_TRIP:
@@ -102,6 +104,13 @@ EVENT_TYPES: Dict[str, str] = {
     EVENT_MAP_PRESSURE:
         "a fixed-capacity device table crossed its pressure warn "
         "threshold (attrs: map, occupancy)",
+    EVENT_THREAT_MODE:
+        "the inline threat-scoring plane changed enforcement mode "
+        "(attrs: mode shadow/enforce/off — an enforce flip means a "
+        "model can now drop/rate-limit/redirect allowed traffic)",
+    EVENT_THREAT_MODEL:
+        "a threat-model weight push hot-swapped through the "
+        "delta-apply path (attrs: generation, repacked)",
 }
 
 # ---------------------------------------------------------------------------
@@ -144,6 +153,12 @@ DEGRADED_SIGNALS: Dict[str, Dict[str, tuple]] = {
         "events": (EVENT_MAP_PRESSURE,),
         "metrics": ("cilium_tpu_map_pressure",
                     "cilium_tpu_map_shard_pressure"),
+    },
+    "threat": {
+        "events": (EVENT_THREAT_MODE, EVENT_THREAT_MODEL),
+        "metrics": ("cilium_tpu_threat_verdicts_total",
+                    "cilium_tpu_threat_score",
+                    "cilium_tpu_threat_model_generation"),
     },
 }
 

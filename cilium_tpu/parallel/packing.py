@@ -51,6 +51,17 @@ FLOW_STATE_GROUP = "flow-state"
 L7_DFA_GROUP = "l7-dfa"
 _L7_DFA_LEAVES = frozenset(
     ("l7_flat", "l7_map", "l7_accept", "l7_starts", "l7_pmask"))
+# the inline threat-scoring model (threat/model.py) packs into its OWN
+# group for the same reason: a no-threat engine builds the exact
+# pre-threat buffer list, and a weight push / threshold flip is a
+# region write into this one buffer (engine apply_threat_weights /
+# set_threat_config), never a repack
+THREAT_MODEL_GROUP = "threat-model"
+_THREAT_MODEL_LEAVES = frozenset(
+    ("tm_w1", "tm_b1", "tm_w2", "tm_b2", "tm_cfg"))
+# the engine-owned mutable threat buffer (threat/stage.ThreatState):
+# not manifest-built, same lint-enforced group namespace as ct-state
+THREAT_STATE_GROUP = "threat-state"
 
 
 class LeafSlot(NamedTuple):
@@ -142,8 +153,12 @@ def build_manifest(tables) -> PackManifest:
     for path, arr in _walk(tables):
         spec = spec_table[path]
         dt = str(arr.dtype)
-        group = L7_DFA_GROUP if path in _L7_DFA_LEAVES \
-            else f"{_sharding_class(spec)}-{dt}"
+        if path in _L7_DFA_LEAVES:
+            group = L7_DFA_GROUP
+        elif path in _THREAT_MODEL_LEAVES:
+            group = THREAT_MODEL_GROUP
+        else:
+            group = f"{_sharding_class(spec)}-{dt}"
         off = offsets.get(group, 0)
         size = int(arr.size)
         leaves.append(LeafSlot(path=path, group=group, offset=off,

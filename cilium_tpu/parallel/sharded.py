@@ -538,6 +538,53 @@ class ShardedDatapath:
     def l7_fast_report(self):
         return self.shards[0].l7_fast_report()
 
+    # ------------------------------------------- inline threat scoring
+
+    def enable_threat(self, model, buckets: int = 1024,
+                      window_s: int = 8, stripe: int = 4) -> None:
+        """Fan the threat scorer to every shard: the quantized model
+        is replicated (every shard scores against the same weights),
+        while each shard owns its OWN ThreatState buffer — token
+        buckets and claim windows are shard-local like the CT state,
+        so one shard's rate-limit debt never throttles a sibling."""
+        for sh in self.shards:
+            sh.enable_threat(model, buckets=buckets,
+                             window_s=window_s, stripe=stripe)
+
+    def disable_threat(self) -> None:
+        for sh in self.shards:
+            sh.disable_threat()
+
+    def set_threat_config(self, config) -> None:
+        for sh in self.shards:
+            sh.set_threat_config(config)
+
+    def apply_threat_weights(self, model) -> bool:
+        fast = True
+        for sh in self.shards:
+            fast = sh.apply_threat_weights(model) and fast
+        return fast
+
+    def threat_report(self):
+        """Merged report: shard 0's model view + per-shard state."""
+        base = self.shards[0].threat_report()
+        if base is None:
+            return None
+        base["shards"] = {str(k): sh.threat_report()
+                          for k, sh in enumerate(self.shards)}
+        base.pop("shard", None)
+        return base
+
+    @property
+    def last_threat(self):
+        """Concatenated last-batch threat lanes (per-shard engines
+        keep their own; diagnostic surface only)."""
+        outs = [sh.last_threat for sh in self.shards
+                if sh.last_threat is not None]
+        if not outs:
+            return None
+        return np.concatenate([np.array(o) for o in outs])
+
     # -------------------------------------------------------- serving
 
     def configure_supervision(self, enabled: bool = True,

@@ -81,6 +81,15 @@ L7_FAST_SPECS: Dict[str, P] = {
     "l7_pmask": REPLICATED,
 }
 
+# Inline threat-scoring model (threat/model.py): the quantized scorer
+# weights + threshold/mode config are replicated — every shard scores
+# its own packets against the same model (its packed dispatch-buffer
+# group is "threat-model" below, so a weight push is a region write).
+THREAT_MODEL_SPECS: Dict[str, P] = {
+    "tm_w1": REPLICATED, "tm_b1": REPLICATED, "tm_w2": REPLICATED,
+    "tm_b2": REPLICATED, "tm_cfg": REPLICATED,
+}
+
 FULL_TABLES_SPECS: Dict[str, P] = {
     **{f"datapath.{k}": v for k, v in DATAPATH_TABLES_SPECS.items()},
     **{f"lb.{k}": v for k, v in LB_TABLES_SPECS.items()},
@@ -92,6 +101,7 @@ FULL_TABLES_SPECS: Dict[str, P] = {
     "tun_plens": REPLICATED,
     "ep_identity": EP_VEC,
     **L7_FAST_SPECS,
+    **THREAT_MODEL_SPECS,
 }
 
 FULL_TABLES6_SPECS: Dict[str, P] = {
@@ -102,6 +112,7 @@ FULL_TABLES6_SPECS: Dict[str, P] = {
     "router_ip6": REPLICATED,
     "ep_identity": EP_VEC,
     **L7_FAST_SPECS,
+    **THREAT_MODEL_SPECS,
 }
 
 # mutable per-shard state: every leaf lives on its owning shard alone
@@ -120,6 +131,13 @@ FLOW_STATE_SPECS: Dict[str, P] = {
 
 COUNTERS_SPECS: Dict[str, P] = {
     "packets": SHARD_LOCAL, "bytes": SHARD_LOCAL,
+}
+
+# the threat plane's mutable buffer (threat/stage.ThreatState): token
+# buckets + claim-window aggregates are shard-local like the CT state
+# — each shard rate-limits and windows its own endpoints' traffic
+THREAT_STATE_SPECS: Dict[str, P] = {
+    "state": SHARD_LOCAL,
 }
 
 # ---------------------------------------------------------------------------
@@ -145,6 +163,15 @@ PACKED_GROUP_SPECS: Dict[str, P] = {
     "flow-state": SHARD_LOCAL,     # 2-leaf flow pack (NOT donated —
     #                                CPU XLA copies donated scatter
     #                                buffers; hubble/aggregation.py)
+    "threat-model": P(),           # quantized scorer weights + config
+    #                                (threat/model.py; its own group so
+    #                                the no-threat program keeps its
+    #                                exact buffer list and a weight
+    #                                push is a region write, never a
+    #                                repack), replicated per shard
+    "threat-state": SHARD_LOCAL,   # [6, T+1] token-bucket/window
+    #                                buffer (NOT donated, the
+    #                                flow-state precedent)
 }
 
 
@@ -155,6 +182,7 @@ def _table_classes():
                                      FullTables6, LPM6Tables)
     from ..datapath.verdict import Counters
     from ..hubble.aggregation import FlowState
+    from ..threat.stage import ThreatState
     return {
         DatapathTables: DATAPATH_TABLES_SPECS,
         LBTables: LB_TABLES_SPECS,
@@ -165,6 +193,7 @@ def _table_classes():
         CTState: CT_STATE_SPECS,
         FlowState: FLOW_STATE_SPECS,
         Counters: COUNTERS_SPECS,
+        ThreatState: THREAT_STATE_SPECS,
     }
 
 

@@ -1,0 +1,35 @@
+"""Inline per-packet ML threat scoring (Taurus-style anomaly plane).
+
+Per PAPERS.md "Taurus: A Data Plane Architecture for Per-Packet ML",
+the dataplane itself scores every packet for anomaly/DDoS behavior
+instead of shipping everything to a host-side detector.  This package
+is that verdict plane:
+
+- ``model.py``   — the small quantized scorer (int32 fixed-point
+  2-layer net) + the policy-controlled threshold/mode config, packed
+  into device table leaves that hot-swap through the delta-apply path.
+- ``stage.py``   — the fused jnp scoring stage both jitted family
+  pipelines run behind the static ``with_threat`` gate, plus the
+  shard-local token-bucket/window state buffer.
+- ``oracle.py``  — the numpy twin of the stage (bit-exact parity
+  reference; tests/test_threat.py holds the line).
+- ``trainer.py`` — host-side fitting from federated Hubble flow drains
+  (plain numpy gradient descent, no new deps).
+"""
+
+from .model import (CFG_BURST, CFG_DROP, CFG_ENFORCE, CFG_GENERATION,
+                    CFG_RATE_Q8, CFG_RATELIMIT, CFG_REDIRECT,
+                    CFG_REDIRECT_PORT, FEATURES, NUM_FEATURES,
+                    SCORE_MAX, ThreatConfig, ThreatModel, default_model)
+from .stage import (ThreatState, make_threat_state, threat_stage,
+                    unpack_threat_out)
+from .trainer import ThreatTrainer
+
+__all__ = [
+    "CFG_BURST", "CFG_DROP", "CFG_ENFORCE", "CFG_GENERATION",
+    "CFG_RATE_Q8", "CFG_RATELIMIT", "CFG_REDIRECT",
+    "CFG_REDIRECT_PORT", "FEATURES", "NUM_FEATURES", "SCORE_MAX",
+    "ThreatConfig", "ThreatModel", "ThreatState", "ThreatTrainer",
+    "default_model", "make_threat_state", "threat_stage",
+    "unpack_threat_out",
+]

@@ -1,0 +1,327 @@
+"""The fused per-packet threat-scoring stage (Taurus-style, jnp).
+
+Runs INSIDE both jitted family pipelines behind the static
+``with_threat`` gate (datapath/pipeline.py), right after the final
+verdict precedence: every packet gets a 0..255 anomaly score from
+
+  * the in-pipeline Hubble flow-table probe (per-flow packet/byte
+    counters + last-seen, read from the same device table the flow
+    tail updates),
+  * the claim-window aggregates kept in the shard-local ThreatState
+    buffer (per-identity new-flow rate + dport-span port-scan signal),
+  * the packet's own tuple features (SYN-without-established, dport,
+    proto, length, WORLD peer, fragment),
+
+then maps the score through the policy-controlled config to a verdict
+arm: drop (VERDICT_DROP_THREAT), redirect-to-proxy, or token-bucket
+rate-limit (probabilistic drop keyed on score once the identity's
+bucket runs dry).  In shadow mode (cfg enforce=0) the verdict is
+provably untouched — the arms are computed for observability only and
+the token buckets are never consumed — so scoring can run against
+production traffic with bit-exact pre-threat verdicts.
+
+Cost shape: the state buffer is BUCKET-major ([T+1, 6] int32 — one
+row per identity bucket, fields as columns) so the whole per-packet
+state read is ONE [B, 6] row gather (pre) plus one (post), and the
+updates collapse to six scatters (window reset as one [B, 4] row-span
+write, counter add, dport min/max, token refill-span write, token
+debit) — scatter cost is per-index, the flow-table lesson.  Feature
+log-buckets come from the float32 exponent (exact for the clamped
+int range, so no 16-compare chains).
+
+Determinism contract: every scatter is either same-value-per-bucket
+(set), commutative (add), or order-free (min/max), so the numpy
+oracle (``oracle.py``) reproduces the device output bit-exactly —
+the parity tests in tests/test_threat.py hold that line.  All
+arithmetic is int32; no value can overflow (the model quantization
+bounds in ``model.py`` size the products).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from ..ops.hashtab_ops import hash_mix_jnp
+from .model import (CFG_BURST, CFG_DROP, CFG_ENFORCE, CFG_RATE_Q8,
+                    CFG_RATELIMIT, CFG_REDIRECT, CFG_REDIRECT_PORT,
+                    SCORE_MAX, WEIGHT_Q)
+
+# ThreatState column layout ([T+1, COLS] int32; row T is the no-op
+# sentinel that absorbs masked scatters, the CT/flow convention)
+COL_TOKENS = 0      # token-bucket fill, Q8.8 (may run negative: debt)
+COL_TB_TS = 1       # last refill timestamp
+COL_WIN_TS = 2      # claim-window start timestamp
+COL_WIN_NEW = 3     # new flows observed in the window
+COL_DPORT_MIN = 4   # smallest dport in the window (65535 on reset)
+COL_DPORT_MAX = 5   # largest dport in the window
+STATE_COLS = 6
+
+# identity -> bucket salt (any fixed odd-ish constant works; the
+# oracle shares it)
+BUCKET_SALT = 0x7EA7
+
+# threat_out lane encoding: score | band << 8 | fired << 10
+ARM_NONE, ARM_RATELIMIT, ARM_REDIRECT, ARM_DROP = 0, 1, 2, 3
+OUT_ARM_SHIFT = 8
+OUT_FIRED_BIT = 1 << 10
+
+# log_bucket clamps its input here: float32 is exact far beyond it,
+# and the 0..16 bucket range saturates at 2^15 anyway
+LOG_CLAMP = 1 << 22
+
+
+class ThreatState(NamedTuple):
+    """The shard-local mutable threat-plane buffer: ONE [T+1, 6] int32
+    dispatch leaf (token buckets + claim-window aggregates), owned per
+    engine like the CT pack — each mesh shard keeps its own copy on
+    its own column (specs.THREAT_STATE_SPECS)."""
+
+    state: jnp.ndarray
+
+
+def make_threat_state(buckets: int) -> ThreatState:
+    assert buckets & (buckets - 1) == 0, "buckets must be a power of 2"
+    return ThreatState(
+        state=jnp.zeros((buckets + 1, STATE_COLS), jnp.int32))
+
+
+def log_bucket(x: jnp.ndarray) -> jnp.ndarray:
+    """Integer floor-log2 bucket (0 for x<=0, else min(16,
+    floor(log2 x)+1)) via the float32 exponent — exact for the
+    clamped range on every backend, mirrored by the oracle."""
+    xc = jnp.clip(x.astype(jnp.int32), 0, LOG_CLAMP)
+    _m, e = jnp.frexp(xc.astype(jnp.float32))
+    return jnp.minimum(jnp.where(xc > 0, e, 0), 16).astype(jnp.int32)
+
+
+def _flow_probe(flows, src_id, dst_id, dport, proto, *,
+                flow_slots: int, flow_probe: int):
+    """Probe the device flow table for each packet's flow under the
+    allowed-traffic key (event TRACE_TO_LXC) — the same exact-compare
+    window walk the aggregation kernel runs, read-only over the
+    PRE-update state.  Returns (found, packets, bytes, last_seen)."""
+    from ..hubble.aggregation import (_LS, _probe_idx, _window_lookup,
+                                      pack_flow_meta)
+    meta = pack_flow_meta(dport.astype(jnp.int32),
+                          proto.astype(jnp.int32),
+                          jnp.zeros_like(dport))       # TRACE_TO_LXC
+    k0 = src_id.astype(jnp.int32)
+    k1 = dst_id.astype(jnp.int32)
+    q = jnp.stack([k0, k1, meta], axis=1)
+    idx = _probe_idx(k0, k1, meta, flow_slots, flow_probe)
+    _got, _hit, found, slot = _window_lookup(flows.keys[:, :3], idx, q)
+    slot = jnp.where(found, slot, jnp.int32(flow_slots))  # sentinel
+    cnt = flows.counters[slot].astype(jnp.int32)          # [B, 2]
+    last = flows.keys[slot, _LS]
+    zero = jnp.zeros_like(slot)
+    return (found, jnp.where(found, cnt[:, 0], zero),
+            jnp.where(found, cnt[:, 1], zero),
+            jnp.where(found, last, zero))
+
+
+def threat_stage(tables, threat: ThreatState, flows, verdict, *,
+                 identity, dport, proto, tcp_flags, length,
+                 is_fragment, established, saddr_w, daddr_w, sport,
+                 flow_src, flow_dst, now, window_s: int,
+                 flow_slots: int = 0, flow_probe: int = 0,
+                 stripe: int = 4, exempt=None):
+    """One fused scoring pass.  ``tables`` carries the tm_* model
+    leaves; ``flows`` is the (pre-update) FlowState or None; all
+    per-packet args are [B] int32 (v6 passes fold6'd address words).
+    ``flow_src``/``flow_dst`` are the oriented flow-key identities the
+    aggregation tail uses (pipeline._flow_identities), so the probe
+    hits exactly the entries the flow plane maintains.
+
+    ``stripe`` (static) stripes the window-aggregate UPDATE: each
+    batch scatters contributions from one rotating contiguous
+    1/stripe block of its rows (the flow table's ls_stripe
+    precedent), so the aggregate is a consistent 1-in-stripe sample
+    of the traffic — feature READS stay per-packet for every row, and
+    the scoring weights absorb the sampling factor.  stripe=1 is the
+    every-row configuration.  Deterministic either way: the phase
+    derives from ``now``, so the oracle mirrors it exactly.
+
+    Returns (verdict', threat', threat_out [B],
+    thr_drop [B] bool, thr_redir [B] bool, rl_drop [B] bool) —
+    the three fired masks feed the provenance tier override."""
+    from jax import lax as _lax
+
+    from ..datapath.verdict import VERDICT_DROP_THREAT
+
+    state = threat.state
+    t = state.shape[0] - 1
+    b = identity.shape[0]
+    cfg = tables.tm_cfg
+    now_i = jnp.int32(now)
+    sentinel = jnp.int32(t)
+
+    # -- claim-window aggregates (per-identity buckets) -----------------
+    bucket = hash_mix_jnp(identity, jnp.full((b,), BUCKET_SALT,
+                                             jnp.int32)) & jnp.int32(t - 1)
+    st_n = max(1, min(stripe, b))
+    width = b // st_n if b % st_n == 0 else b
+
+    def _sl(x):
+        if width == b:
+            return x
+        phase = jnp.remainder(now_i, jnp.int32(st_n))
+        return _lax.dynamic_slice_in_dim(x, phase * width, width)
+
+    bucket_s = _sl(bucket)
+    win_ts = state[bucket_s, COL_WIN_TS]
+    expired = (now_i - win_ts) >= jnp.int32(window_s)
+    tgt_exp = jnp.where(expired, bucket_s, sentinel)
+    reset_vals = jnp.broadcast_to(
+        jnp.array([0, 0, 65535, 0], jnp.int32)
+        .at[0].set(now_i)[None, :], (width, 4))
+    state = state.at[tgt_exp, COL_WIN_TS:].set(reset_vals)
+    new_flow_s = _sl(~established)
+    dport_s = _sl(dport)
+    state = state.at[jnp.where(new_flow_s, bucket_s, sentinel),
+                     COL_WIN_NEW].add(1)
+    state = state.at[bucket_s, COL_DPORT_MIN].min(dport_s)
+    state = state.at[bucket_s, COL_DPORT_MAX].max(dport_s)
+    post = state[bucket]                                  # [B, 6]
+    win_new = post[:, COL_WIN_NEW]
+    spread = jnp.maximum(post[:, COL_DPORT_MAX] -
+                         post[:, COL_DPORT_MIN], 0)
+
+    # -- flow-table probe (per-flow history) ----------------------------
+    if flows is not None and flow_slots > 0:
+        found, fl_pkts, fl_bytes, fl_last = _flow_probe(
+            flows, flow_src, flow_dst, dport, proto,
+            flow_slots=flow_slots, flow_probe=flow_probe)
+    else:
+        found = jnp.zeros(b, bool)
+        fl_pkts = fl_bytes = fl_last = jnp.zeros(b, jnp.int32)
+
+    # -- feature lanes (model.FEATURES order, each 0..255) --------------
+    full = jnp.full((b,), SCORE_MAX, jnp.int32)
+    zero = jnp.zeros(b, jnp.int32)
+    syn = (tcp_flags & jnp.int32(0x02)) != 0
+    is_tcp = proto == jnp.int32(6)
+    recency = jnp.where(found,
+                        jnp.clip(now_i - fl_last, 0, SCORE_MAX), full)
+    feats = jnp.stack([
+        15 * log_bucket(fl_pkts),
+        15 * log_bucket(fl_bytes),
+        recency,
+        jnp.where(syn & is_tcp & ~established, full, zero),
+        jnp.where(established, full, zero),
+        15 * log_bucket(win_new),
+        15 * log_bucket(spread),
+        jnp.minimum(dport >> 8, SCORE_MAX),
+        jnp.where(proto == jnp.int32(17), full, zero),
+        15 * log_bucket(length),
+        jnp.where(identity == jnp.int32(2), full, zero),  # WORLD
+        jnp.where(is_fragment != 0, full, zero),
+    ], axis=1)                                            # [B, F]
+
+    # -- the quantized scorer (MXU-shaped: two small contractions) ------
+    z1 = jnp.sum(feats[:, :, None] * tables.tm_w1[None, :, :],
+                 axis=1) >> WEIGHT_Q
+    h = jnp.clip(z1 + tables.tm_b1[None, :], 0, SCORE_MAX)
+    z2 = jnp.sum(h * tables.tm_w2[None, :], axis=1) >> WEIGHT_Q
+    score = jnp.clip(z2 + tables.tm_b2[0], 0, SCORE_MAX)
+
+    # -- verdict arms + token bucket, behind a runtime gate -------------
+    # The whole enforcement half (arm classification, the tuple-hash
+    # uniform, the token bucket and the verdict override) runs under a
+    # lax.cond on "any arm threshold armed": in score-only mode (every
+    # threshold 0 — the shadow default) it is SKIPPED at runtime, so
+    # pure scoring pays for the scorer alone.  Semantics are identical
+    # either way: with all thresholds 0 the armed branch computes
+    # all-False masks and writes nothing (the numpy oracle mirrors the
+    # unconditional math).
+    from jax import lax
+
+    enforce = cfg[CFG_ENFORCE] != 0
+    eligible = verdict >= 0          # never overrides an existing drop
+    if exempt is not None:
+        # rows another stage answered terminally (the v6 local ICMPv6
+        # responder) are scored but never overridden
+        eligible = eligible & ~exempt
+    any_arm = (cfg[CFG_DROP] > 0) | (cfg[CFG_REDIRECT] > 0) | \
+        (cfg[CFG_RATELIMIT] > 0)
+
+    def _armed(state):
+        drop_arm = eligible & (cfg[CFG_DROP] > 0) & \
+            (score >= cfg[CFG_DROP])
+        redir_arm = eligible & ~drop_arm & (cfg[CFG_REDIRECT] > 0) & \
+            (score >= cfg[CFG_REDIRECT])
+        rl_arm = eligible & ~drop_arm & ~redir_arm & \
+            (cfg[CFG_RATELIMIT] > 0) & (score >= cfg[CFG_RATELIMIT])
+        # token bucket (rate-limit arm, enforce only; batch-granular:
+        # same-batch rows of one bucket share the pre-batch token
+        # view, consumption lands as one accumulated debit)
+        want = rl_arm & enforce
+        # cols 0/1 are untouched by the window scatters, so the
+        # post-window gather IS the pre-batch token view
+        dt = jnp.clip(now_i - post[:, COL_TB_TS], 0, 3600)
+        refilled = jnp.minimum(
+            cfg[CFG_BURST] << WEIGHT_Q,
+            post[:, COL_TOKENS] + cfg[CFG_RATE_Q8] * dt)
+        has_token = refilled >= jnp.int32(1 << WEIGHT_Q)
+        # probabilistic drop keyed on score once the bucket is dry:
+        # the per-packet uniform derives from the tuple + timestamp
+        # hash (the host oracle mirrors the exact mix)
+        word = ((sport & jnp.int32(0xFFFF)) << 16) | \
+            (dport & jnp.int32(0xFFFF))
+        prand = hash_mix_jnp(
+            hash_mix_jnp(saddr_w, daddr_w),
+            hash_mix_jnp(word, jnp.full((b,), 0, jnp.int32)
+                         + now_i)) & jnp.int32(0xFF)
+        denom = jnp.maximum(jnp.int32(256) - cfg[CFG_RATELIMIT], 1)
+        p = jnp.clip((score - cfg[CFG_RATELIMIT] + 1) * 255 // denom,
+                     0, 255)
+        rl_drop = want & ~has_token & (prand < p)
+        tgt_want = jnp.where(want, bucket, sentinel)
+        state = state.at[tgt_want, COL_TOKENS:COL_WIN_TS].set(
+            jnp.stack([refilled, jnp.broadcast_to(now_i, (b,))],
+                      axis=1))
+        consumed = want & has_token
+        state = state.at[jnp.where(consumed, bucket, sentinel),
+                         COL_TOKENS].add(jnp.int32(-(1 << WEIGHT_Q)))
+        state = state.at[sentinel].set(
+            jnp.zeros(STATE_COLS, jnp.int32))
+        # final verdict override (enforce only; shadow is bit-exact)
+        thr_drop = (drop_arm & enforce) | rl_drop
+        thr_redir = redir_arm & enforce & (verdict == 0)
+        v = jnp.where(
+            thr_drop, jnp.int32(VERDICT_DROP_THREAT),
+            jnp.where(thr_redir, cfg[CFG_REDIRECT_PORT], verdict))
+        band = jnp.where(
+            drop_arm, jnp.int32(ARM_DROP),
+            jnp.where(redir_arm, jnp.int32(ARM_REDIRECT),
+                      jnp.where(rl_arm, jnp.int32(ARM_RATELIMIT),
+                                jnp.int32(ARM_NONE))))
+        return v, state, band, thr_drop, thr_redir, rl_drop
+
+    def _score_only(state):
+        state = state.at[sentinel].set(
+            jnp.zeros(STATE_COLS, jnp.int32))
+        false = jnp.zeros(b, bool)
+        return (verdict, state, jnp.zeros(b, jnp.int32), false,
+                false, false)
+
+    verdict, state, band, thr_drop, thr_redir, rl_drop = lax.cond(
+        any_arm, _armed, _score_only, state)
+
+    fired = thr_drop | thr_redir
+    threat_out = score | (band << OUT_ARM_SHIFT) | \
+        jnp.where(fired, jnp.int32(OUT_FIRED_BIT), jnp.int32(0))
+    return (verdict, ThreatState(state=state), threat_out,
+            thr_drop, thr_redir, rl_drop)
+
+
+def unpack_threat_out(out) -> Tuple:
+    """Decode the packed [B] threat_out lane -> (score, band, fired)
+    numpy arrays (host-side; monitor/daemon consumers)."""
+    import numpy as _np
+    arr = _np.array(out, _np.int32)
+    score = arr & 0xFF
+    band = (arr >> OUT_ARM_SHIFT) & 0x3
+    fired = (arr & OUT_FIRED_BIT) != 0
+    return score, band, fired

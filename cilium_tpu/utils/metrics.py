@@ -127,15 +127,23 @@ class Histogram(_Metric):
 
     def observe(self, value: float,
                 labels: Optional[Dict[str, str]] = None) -> None:
+        self.observe_many(value, 1, labels)
+
+    def observe_many(self, value: float, count: int,
+                     labels: Optional[Dict[str, str]] = None) -> None:
+        """Record ``count`` identical observations in one locked pass
+        — the batched-ingest path (e.g. per-packet threat scores
+        grouped by distinct value) without a Python loop per packet."""
         key = _lk(labels)
+        count = int(count)
         with self._lock:
             counts = self._counts.setdefault(
                 key, [0] * len(self.buckets))
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
-                    counts[i] += 1
-            self._sums[key] = self._sums.get(key, 0.0) + value
-            self._totals[key] = self._totals.get(key, 0) + 1
+                    counts[i] += count
+            self._sums[key] = self._sums.get(key, 0.0) + value * count
+            self._totals[key] = self._totals.get(key, 0) + count
 
     def count(self, labels: Optional[Dict[str, str]] = None) -> int:
         with self._lock:
@@ -306,6 +314,22 @@ L7_FAST_VERDICTS = registry.counter(
     "l7_fast_verdicts_total",
     "L7 requests decided inline by the on-device fast-verdict stage "
     "(proxy bypassed), by protocol and outcome")
+# Inline threat scoring (threat/ + the fused scoring stage in
+# datapath/pipeline.py): per-packet anomaly verdict accounting, the
+# score distribution, and the live model generation.
+THREAT_VERDICTS = registry.counter(
+    "threat_verdicts_total",
+    "Packets scored by the inline threat stage, by outcome (scored = "
+    "no override incl. every shadow-mode packet; rate-limited / "
+    "redirected / dropped = enforce-mode overrides)")
+THREAT_SCORES = registry.histogram(
+    "threat_score",
+    "Distribution of inline per-packet threat scores (0..255)",
+    buckets=(8, 16, 32, 64, 96, 128, 160, 192, 224, 256))
+THREAT_MODEL_GENERATION = registry.gauge(
+    "threat_model_generation",
+    "Generation of the threat-scoring model currently serving "
+    "(bumped on every weight hot-swap)")
 PROXY_UPSTREAM_TIME = registry.histogram(
     "proxy_upstream_reply_seconds", "Proxy upstream reply time")
 DROP_COUNT = registry.counter(

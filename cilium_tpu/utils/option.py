@@ -319,6 +319,21 @@ class DaemonConfig:
     # reconnect reconcile rate limit (journal replay + local-key
     # repair ops per second; 0 = unthrottled)
     kvstore_reconcile_ops_per_s: float = 2000.0
+    # inline per-packet threat scoring (cilium_tpu/threat/): when
+    # enabled, both jitted family pipelines fuse the quantized anomaly
+    # scorer; default mode is SHADOW (score-only — verdicts are
+    # bit-exact pre-threat until an operator flips to enforce, and
+    # every enforcement arm threshold defaults to disabled anyway).
+    enable_threat: bool = False
+    threat_mode: str = "shadow"        # shadow | enforce
+    threat_buckets: int = 1024         # per-identity window/bucket slots
+    threat_window_s: int = 8           # claim-window span (seconds)
+    threat_drop_score: int = 0         # score >= this drops (0 = off)
+    threat_redirect_score: int = 0     # score >= this redirects (0 = off)
+    threat_ratelimit_score: int = 0    # score >= this rate-limits (0 = off)
+    threat_redirect_port: int = 0      # the redirect arm's proxy port
+    threat_rate_per_s: float = 256.0   # token-bucket refill rate
+    threat_burst: int = 1024           # token-bucket capacity
     kvstore: str = "memory"
     kvstore_opts: Dict[str, str] = field(default_factory=dict)
     # runtime-mutable option map shared by new endpoints

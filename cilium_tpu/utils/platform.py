@@ -196,7 +196,23 @@ def compact_bench_line(parsed: dict, full_file: "str | None" = None,
     if full_file:
         ex["full"] = os.path.basename(full_file)
     out["extra"] = ex
-    # size guard: drop the biggest optional blocks until the line fits
+    # size guard, graduated: first shed row-level detail from the
+    # suite block (per-config overhead_pct, then the engine tags the
+    # contract doesn't pin — http-regex/fqdn keep theirs), THEN drop
+    # whole optional blocks.  The suite {value, vs_baseline} pairs are
+    # the last thing to go: they are the per-config record the driver
+    # line exists to carry.
+    suite_rows = ex.get("suite")
+    if isinstance(suite_rows, dict):
+        if len(json.dumps(out)) > limit:
+            for row in suite_rows.values():
+                if isinstance(row, dict):
+                    row.pop("overhead_pct", None)
+        if len(json.dumps(out)) > limit:
+            for name, row in suite_rows.items():
+                if isinstance(row, dict) and \
+                        name not in ("http-regex", "fqdn"):
+                    row.pop("eng", None)
     for drop in ("device", "p99_b256_us", "last_on_accel", "suite"):
         if len(json.dumps(out)) <= limit:
             break

@@ -58,7 +58,7 @@ def test_smoke_final_line_parses_and_fits(tmp_path):
     for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
                  "l7-fast", "capacity", "incremental", "latency-tier",
                  "dispatch-floor", "overload", "mesh-shard",
-                 "control-churn"):
+                 "threat-score", "control-churn"):
         assert name in suite, f"{name} missing from compact suite"
         assert "value" in suite[name]
         assert "vs_baseline" in suite[name]
@@ -119,6 +119,22 @@ def test_smoke_writes_full_result_file(tmp_path):
         assert key in l7["extra"]["http"], key
     for key in ("fast_p50_us", "fast_p99_us", "engine_p99_us"):
         assert key in l7["extra"]["dns"], key
+    # the threat-score schema is pinned: fused-scoring overhead vs the
+    # pre-threat program (gated <= 10%), the enforce-mode arm sample,
+    # the train->hot-swap zero-repack proof, and the disabled-path
+    # byte-identity gate
+    th = res["extra"]["suite_configs"]["threat-score"]
+    assert th["unit"] == "verdicts/s"
+    for key in ("baseline_vps", "threat_vps", "overhead_pct",
+                "gate_overhead_le_10pct", "enforce",
+                "threat_disabled_byte_identical"):
+        assert key in th["extra"], key
+    for key in ("scored", "rate_limited", "redirected", "dropped"):
+        assert key in th["extra"]["enforce"], key
+    hs = th["extra"]["hot_swap"]
+    for key in ("push_ms", "hot_swap_applied", "zero_repacks",
+                "generation", "no_serving_pause"):
+        assert key in hs, key
     # the overload schema is pinned: per-multiplier legs with accepted
     # percentiles + shed accounting, admission vs unbounded
     ovl = res["extra"]["suite_configs"]["overload"]
@@ -248,6 +264,37 @@ def test_committed_l7_fast_artifact_is_real():
     assert ex["http"]["proxy_connections_proxy_leg"] > 0
     assert ex["fast_disabled_byte_identical"] is True
     assert ex["requests_per_sec"] > 0
+
+
+def test_committed_threat_score_artifact_is_real():
+    """The committed CPU artifact must prove the threat tentpole's
+    claims: fused shadow scoring within the <=10% overhead gate on
+    the 1000-rule config, a train->hot-swap weight push with zero
+    repacks and no serving pause, and the threat-disabled pipeline
+    byte-identical (lowered HLO)."""
+    import glob
+    found = []
+    for f in sorted(glob.glob(os.path.join(REPO, "BENCH_FULL_*.json"))):
+        try:
+            doc = json.load(open(f))
+        except (OSError, ValueError):
+            continue
+        cfg = doc.get("result", {}).get("extra", {}) \
+            .get("suite_configs", {}).get("threat-score")
+        if isinstance(cfg, dict) and not cfg.get("extra",
+                                                 {}).get("smoke"):
+            found.append(cfg)
+    assert found, \
+        "no committed BENCH_FULL_*.json carries a real threat-score " \
+        "config"
+    ex = found[-1]["extra"]
+    assert ex["gate_overhead_le_10pct"] is True
+    assert ex["overhead_pct"] <= 10.0
+    assert ex["hot_swap"]["hot_swap_applied"] is True
+    assert ex["hot_swap"]["zero_repacks"] is True
+    assert ex["hot_swap"]["no_serving_pause"] is True
+    assert ex["threat_disabled_byte_identical"] is True
+    assert ex["enforce"]["dropped"] + ex["enforce"]["rate_limited"] > 0
 
 
 def test_committed_multichip_artifact_is_real():

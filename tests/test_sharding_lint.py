@@ -104,9 +104,14 @@ V6_STEP_LEAF_CEILING = 17
 # lane (the per-slot l7_prog classification rides inside ep-int32) —
 # pinned so the fast path can't silently regrow the dispatch floor
 PACKED_STEP_WITH_L7_CEILING = PACKED_STEP_LEAF_CEILING + 2
+# inline threat scoring likewise adds exactly TWO leaves: the fused
+# threat-model group (quantized weights + config as ONE buffer) and
+# the [6, T+1] shard-local ThreatState token-bucket/window buffer
+PACKED_STEP_WITH_THREAT_CEILING = PACKED_STEP_LEAF_CEILING + 2
 
 
-def _loaded_engine(flows: bool = False, l7_fast: bool = False):
+def _loaded_engine(flows: bool = False, l7_fast: bool = False,
+                   threat: bool = False):
     from bench import build_config1
     from cilium_tpu.datapath.engine import Datapath
     states, prefixes = build_config1(n_rules=10, n_endpoints=4)
@@ -122,6 +127,9 @@ def _loaded_engine(flows: bool = False, l7_fast: bool = False):
             [FastProgramSpec(port=15001, protocol="http",
                              patterns=("GET\x00/x\x00.*",))],
             window=32))
+    if threat:
+        from cilium_tpu.threat import default_model
+        dp.enable_threat(default_model(), buckets=1 << 8)
     dp.load_policy(states, revision=1, ipcache_prefixes=prefixes)
     return dp
 
@@ -164,13 +172,38 @@ def test_jitted_step_leaf_ceiling_with_l7_fast():
     assert packing.L7_DFA_GROUP not in plain._manifest4.group_names()
 
 
+def test_jitted_step_leaf_ceiling_with_threat():
+    """The threat-scoring step: the fused threat-model group + the
+    ThreatState buffer are the ONLY new leaves, the model packs into
+    its own group (the no-threat program keeps the exact pre-threat
+    buffer list), and the token-bucket state carries a declared
+    shard-local spec."""
+    from cilium_tpu.parallel import packing
+    dp = _loaded_engine(threat=True)
+    counts = dp.dispatch_leaf_counts()
+    assert counts["packed-step"] <= PACKED_STEP_WITH_THREAT_CEILING, \
+        counts
+    assert packing.THREAT_MODEL_GROUP in dp._manifest4.group_names()
+    assert packing.THREAT_MODEL_GROUP in dp._manifest6.group_names()
+    plain = _loaded_engine()
+    assert packing.THREAT_MODEL_GROUP not in \
+        plain._manifest4.group_names()
+    # the token-bucket leaf is registered shard-local, like CT
+    assert specs.THREAT_STATE_SPECS["state"] == specs.SHARD_LOCAL
+    assert "ThreatState" in specs.registry()
+    assert specs.PACKED_GROUP_SPECS[packing.THREAT_STATE_GROUP] == \
+        specs.SHARD_LOCAL
+
+
 def test_every_packed_group_has_a_declared_spec():
     from cilium_tpu.parallel import packing
     dp = _loaded_engine(l7_fast=True)
+    thr = _loaded_engine(threat=True)
     groups = (set(dp._manifest4.group_names())
               | set(dp._manifest6.group_names())
+              | set(thr._manifest4.group_names())
               | {packing.CT_STATE_GROUP, packing.COUNTERS_GROUP,
-                 packing.FLOW_STATE_GROUP})
+                 packing.FLOW_STATE_GROUP, packing.THREAT_STATE_GROUP})
     undeclared = groups - set(specs.PACKED_GROUP_SPECS)
     assert not undeclared, (
         "packed dispatch-buffer groups without a declared "

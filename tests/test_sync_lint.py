@@ -46,6 +46,13 @@ HOT_MODULES = (
     # control-plane, but its payload-encode helpers run per serving
     # submission — zero sync markers by construction
     "cilium_tpu/l7/fast.py",
+    # the inline threat-scoring plane: the fused stage + model math
+    # run inside the jitted steps, the oracle/trainer are host-side
+    # parity/fit code — zero sync markers by construction in all four
+    "cilium_tpu/threat/stage.py",
+    "cilium_tpu/threat/model.py",
+    "cilium_tpu/threat/oracle.py",
+    "cilium_tpu/threat/trainer.py",
 )
 
 # the engine is hot only in its dispatch functions — table loading,

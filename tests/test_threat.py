@@ -1,0 +1,591 @@
+"""Inline per-packet ML threat scoring (cilium_tpu/threat/): the
+Taurus-style anomaly verdict plane fused into the jitted pipelines.
+
+- **Score parity** — device scores/arms/verdict overrides replayed
+  against the numpy oracle bit-exactly, across seeds and batches, v4
+  AND v6, with flows + provenance fused (the full-pipeline shape).
+- **Shadow is bit-exact** — scoring fused in shadow mode never changes
+  a verdict/event/tier vs the pre-threat engine on identical traffic.
+- **Enforce arms** — drop / redirect / token-bucket rate-limit,
+  DROP_THREAT events + TIER_THREAT_* provenance.
+- **Hot-swap** — weight pushes and threshold/mode flips are leaf
+  writes through the delta-apply path: zero repacks, no re-jit.
+- **Disabled path** — enable->disable lowers the byte-identical
+  pre-threat program (lowered-HLO-asserted).
+- **Sharded isolation** — per-shard token-bucket/window state.
+- **Supervisor degraded** — fail-static serves POLICY verdicts: a
+  broken device lane (and with it the model) can never deny traffic
+  the policy allows.
+- **Live-daemon journey** — train from the flow plane -> hot-swap
+  push -> status/REST -> flight-recorder events on mode flips.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.datapath.engine import Datapath, make_full_batch6
+from cilium_tpu.datapath.events import (DROP_THREAT, TIER_NAMES,
+                                        TIER_THREAT_DROP,
+                                        TIER_THREAT_RATELIMIT,
+                                        TIER_THREAT_REDIRECT)
+from cilium_tpu.datapath.pipeline import PACKED_FIELDS
+from cilium_tpu.datapath.verdict import VERDICT_DROP, VERDICT_DROP_THREAT
+from cilium_tpu.policy.mapstate import (EGRESS, INGRESS, PolicyKey,
+                                        PolicyMapState,
+                                        PolicyMapStateEntry)
+from cilium_tpu.threat import (NUM_FEATURES, ThreatConfig, ThreatModel,
+                               ThreatTrainer, default_model)
+from cilium_tpu.threat.model import linear_model
+from cilium_tpu.threat.oracle import (flow_snapshot_index,
+                                      oracle_threat_step)
+from cilium_tpu.threat.stage import STATE_COLS, unpack_threat_out
+
+HTTP_ID, DNS_ID = 777, 888
+WORLD = 2
+EP_IDENTITY = 1234
+BUCKETS = 256
+
+
+def _policy():
+    st = PolicyMapState()
+    st[PolicyKey(identity=HTTP_ID, dest_port=80, nexthdr=6,
+                 direction=INGRESS)] = PolicyMapStateEntry()
+    st[PolicyKey(identity=DNS_ID, dest_port=53, nexthdr=17,
+                 direction=EGRESS)] = PolicyMapStateEntry()
+    return st
+
+
+ENFORCE_CFG = ThreatConfig(mode="enforce", drop_score=235,
+                           ratelimit_score=150, rate_per_s=2.0,
+                           burst=4)
+
+
+def _engine(config=None, flows=True, provenance=True, threat=True,
+            ct_slots=1 << 10, model=None):
+    dp = Datapath(ct_slots=ct_slots)
+    dp.telemetry_enabled = False
+    if provenance:
+        dp.enable_provenance()
+    if flows:
+        dp.enable_flow_aggregation(slots=1 << 8, claim_every=1)
+    if threat:
+        dp.enable_threat(model or default_model(
+            config or ThreatConfig()), buckets=BUCKETS, window_s=8)
+    dp.load_policy([_policy()], revision=1, ipcache_prefixes={
+        "10.0.0.0/8": HTTP_ID, "20.0.0.0/8": DNS_ID})
+    dp.set_endpoint_identity(0, EP_IDENTITY)
+    return dp
+
+
+def _traffic(rng, n, sport0):
+    """Mixed batch: allowed HTTP ingress (10/8 -> 777), allowed DNS
+    egress (daddr 20/8 -> 888), and WORLD-sourced denied rows."""
+    kind = rng.integers(0, 3, n)           # 0 http, 1 dns, 2 denied
+    is_http = kind == 0
+    is_dns = kind == 1
+    saddr = np.where(is_http, (10 << 24) | 5, (50 << 24) | 9) \
+        .astype(np.uint32)
+    daddr = np.where(is_dns, (20 << 24) | 9, (10 << 24) | 8) \
+        .astype(np.uint32)
+    recs = {
+        "endpoint": np.zeros(n, np.int32),
+        "saddr": saddr.view(np.int32),
+        "daddr": daddr.view(np.int32),
+        "sport": (sport0 + np.arange(n)).astype(np.int32),
+        "dport": np.where(is_http, 80,
+                          np.where(is_dns, 53,
+                                   rng.integers(1, 65536, n))
+                          ).astype(np.int32),
+        "proto": np.where(is_dns, 17, 6).astype(np.int32),
+        "direction": np.where(is_http, 0, 1).astype(np.int32),
+        "tcp_flags": np.where(rng.random(n) < 0.5, 0x02, 0x10)
+        .astype(np.int32),
+        "length": rng.integers(60, 1500, n).astype(np.int32),
+        "is_fragment": np.zeros(n, np.int32),
+    }
+    stage = np.empty((len(PACKED_FIELDS), n), np.int32)
+    for i, f in enumerate(PACKED_FIELDS):
+        stage[i] = recs[f]
+    return stage, recs
+
+
+def _identities(recs):
+    """Host ipcache twin: resolved peer identity per row."""
+    sa = recs["saddr"].view(np.uint32)
+    da = recs["daddr"].view(np.uint32)
+    peer = np.where(recs["direction"] == 0, sa, da)
+    ident = np.full(peer.shape[0], WORLD, np.int32)
+    ident[(peer >> 24) == 10] = HTTP_ID
+    ident[(peer >> 24) == 20] = DNS_ID
+    return ident
+
+
+def _policy_verdict(ident, recs):
+    """Host policy twin of the two installed rules."""
+    ok = ((ident == HTTP_ID) & (recs["dport"] == 80) &
+          (recs["proto"] == 6) & (recs["direction"] == 0)) | \
+         ((ident == DNS_ID) & (recs["dport"] == 53) &
+          (recs["proto"] == 17) & (recs["direction"] == 1))
+    return np.where(ok, 0, VERDICT_DROP).astype(np.int32)
+
+
+def _established_from_ct(dp, recs):
+    """Pre-batch established view from the live CT dump (forward
+    tuples only; test traffic never sends replies)."""
+    live = {(e["saddr"], e["daddr"], e["sport"], e["dport"],
+             e["proto"]) for e in dp.map_dump("ct", max_entries=1 << 14)}
+    sa = recs["saddr"].view(np.uint32)
+    da = recs["daddr"].view(np.uint32)
+    return np.array([
+        (int(sa[i]), int(da[i]), int(recs["sport"][i]),
+         int(recs["dport"][i]), int(recs["proto"][i])) in live
+        for i in range(sa.shape[0])], bool)
+
+
+def _oracle_flow_ids(ident, recs):
+    """pipeline._flow_identities twin: (src, dst) flow-key identities
+    for endpoint slot 0 (own identity EP_IDENTITY)."""
+    egress = recs["direction"] == 1
+    src = np.where(egress, EP_IDENTITY, ident)
+    dst = np.where(egress, ident, EP_IDENTITY)
+    return src, dst
+
+
+# ------------------------------------------------------ score parity
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_score_parity_vs_oracle_v4(seed):
+    """Device scores, bands, fired masks, verdict overrides AND the
+    evolving token-bucket/window state replay bit-exactly against the
+    numpy oracle over multiple batches — flows + provenance fused,
+    enforce mode with live drop + rate-limit arms."""
+    rng = np.random.default_rng(seed)
+    model = default_model(ENFORCE_CFG)
+    dp = _engine(model=model)
+    mirror = np.zeros((BUCKETS + 1, STATE_COLS), np.int32)
+    now = 1000
+    sport0 = 20000
+    for batch in range(3):
+        n = 96
+        stage, recs = _traffic(rng, n, sport0)
+        if batch == 2:
+            # re-hit batch 0's tuples: established flows + flow-table
+            # history exercise the CT/flow features
+            stage[3] = 20000 + np.arange(n)
+            recs["sport"] = stage[3].copy()
+        sport0 += n
+        ident = _identities(recs)
+        pre_verdict = np.where(_established_from_ct(dp, recs), 0,
+                               _policy_verdict(ident, recs))
+        established = _established_from_ct(dp, recs)
+        pre_verdict = np.where(established, 0,
+                               _policy_verdict(ident, recs))
+        flow_index = flow_snapshot_index(dp.flow_snapshot(1 << 14))
+        fsrc, fdst = _oracle_flow_ids(ident, recs)
+        exp_v, exp_out, exp_score, exp_band, exp_drop, exp_redir, \
+            exp_rl = oracle_threat_step(
+                mirror, model, pre_verdict, identity=ident,
+                dport=recs["dport"], proto=recs["proto"],
+                tcp_flags=recs["tcp_flags"], length=recs["length"],
+                is_fragment=recs["is_fragment"],
+                established=established,
+                saddr_w=recs["saddr"], daddr_w=recs["daddr"],
+                sport=recs["sport"], flow_src=fsrc, flow_dst=fdst,
+                now=now, window_s=8, flow_index=flow_index)
+        v, e, got_ident, _nat = dp.process_packed(stage, now=now)
+        v = np.asarray(v)
+        np.testing.assert_array_equal(np.asarray(got_ident), ident)
+        np.testing.assert_array_equal(
+            np.asarray(dp.last_threat), exp_out,
+            err_msg=f"threat_out diverged (batch {batch})")
+        np.testing.assert_array_equal(
+            v, exp_v, err_msg=f"verdict diverged (batch {batch})")
+        # the device state buffer matches the oracle mirror exactly
+        np.testing.assert_array_equal(
+            np.asarray(dp.threat_state.state), mirror,
+            err_msg=f"threat state diverged (batch {batch})")
+        # provenance tiers for fired rows
+        tiers = np.asarray(dp.last_provenance.tier)
+        assert (tiers[exp_rl] == TIER_THREAT_RATELIMIT).all()
+        assert (tiers[exp_drop & ~exp_rl] == TIER_THREAT_DROP).all()
+        now += 3
+
+
+def test_score_parity_vs_oracle_v6():
+    """The v6 twin scores through the shared model; tuple hashes use
+    the CT address folds."""
+    from cilium_tpu.datapath.pipeline import fold6
+    import jax.numpy as jnp
+    model = default_model(ENFORCE_CFG)
+    dp = Datapath(ct_slots=1 << 8)
+    dp.telemetry_enabled = False
+    dp.enable_provenance()
+    dp.enable_threat(model, buckets=BUCKETS, window_s=8)
+    dp.load_policy([_policy()], revision=1)
+    dp.load_ipcache6({"fd00::/16": HTTP_ID})
+    dp.set_endpoint_identity(0, EP_IDENTITY)
+    n = 24
+    pkt = make_full_batch6(
+        endpoint=[0] * n, saddr=["fd00::5"] * n, daddr=["fd00::9"] * n,
+        sport=[30000 + i for i in range(n)], dport=[80] * n,
+        proto=[6] * n, direction=[0] * n)
+    mirror = np.zeros((BUCKETS + 1, STATE_COLS), np.int32)
+    ident = np.full(n, HTTP_ID, np.int32)
+    saddr_w = np.asarray(fold6(pkt.saddr))
+    daddr_w = np.asarray(fold6(pkt.daddr))
+    exp_v, exp_out, *_rest = oracle_threat_step(
+        mirror, model, np.zeros(n, np.int32), identity=ident,
+        dport=np.asarray(pkt.dport), proto=np.asarray(pkt.proto),
+        tcp_flags=np.asarray(pkt.tcp_flags),
+        length=np.asarray(pkt.length),
+        is_fragment=np.asarray(pkt.is_fragment),
+        established=np.zeros(n, bool), saddr_w=saddr_w,
+        daddr_w=daddr_w, sport=np.asarray(pkt.sport),
+        flow_src=ident, flow_dst=np.full(n, EP_IDENTITY, np.int32),
+        now=500, window_s=8, flow_index=None)
+    v, e, _i, _nat = dp.process6(pkt, now=500)
+    np.testing.assert_array_equal(np.asarray(dp.last_threat), exp_out)
+    np.testing.assert_array_equal(np.asarray(v), exp_v)
+    np.testing.assert_array_equal(np.asarray(dp.threat_state.state),
+                                  mirror)
+
+
+# ------------------------------------------------- shadow bit-exact
+
+def test_shadow_mode_never_changes_verdicts():
+    """Shadow-mode scoring over identical traffic produces bit-exact
+    verdicts/events/tiers vs a threat-free twin — even with a model
+    that would drop everything in enforce mode."""
+    hot = linear_model(np.full(NUM_FEATURES, 2000.0), bias=255,
+                       config=ThreatConfig(mode="shadow",
+                                           drop_score=1))
+    a = _engine(model=hot)
+    b = _engine(threat=False)
+    rng = np.random.default_rng(99)
+    now = 2000
+    for batch in range(3):
+        stage, _recs = _traffic(np.random.default_rng(99 + batch), 64,
+                                40000 + 64 * batch)
+        va, ea, ia, _ = a.process_packed(stage, now=now)
+        vb, eb, ib, _ = b.process_packed(stage.copy(), now=now)
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+        np.testing.assert_array_equal(np.asarray(ea), np.asarray(eb))
+        np.testing.assert_array_equal(
+            np.asarray(a.last_provenance.tier),
+            np.asarray(b.last_provenance.tier))
+        # the scorer RAN: max-weight model saturates the score lane,
+        # and eligible (policy-allowed) rows classify into the drop
+        # band — without firing
+        score, band, fired = unpack_threat_out(a.last_threat)
+        assert (score == 255).all()
+        assert (band[np.asarray(vb) >= 0] == 3).all()
+        assert not fired.any(), "shadow mode must never fire"
+        now += 1
+
+
+# ------------------------------------------------------ enforce arms
+
+def test_enforce_drop_arm():
+    dp = _engine(model=default_model(
+        ThreatConfig(mode="enforce", drop_score=100)), flows=False)
+    stage, recs = _traffic(np.random.default_rng(1), 32, 50000)
+    v, e, _i, _n = dp.process_packed(stage, now=100)
+    v, e = np.asarray(v), np.asarray(e)
+    allowed = _policy_verdict(_identities(recs), recs) == 0
+    assert allowed.any()
+    # every policy-allowed row scores as a fresh SYN-ish flow over the
+    # default model -> above the drop threshold -> DROP_THREAT
+    score, _band, fired = unpack_threat_out(dp.last_threat)
+    should = allowed & (score >= 100)
+    assert should.any()
+    assert (v[should] == VERDICT_DROP_THREAT).all()
+    assert (e[should] == DROP_THREAT).all()
+    # policy-denied rows keep their ORIGINAL drop (never re-tiered)
+    assert (v[~allowed] == VERDICT_DROP).all()
+
+
+def test_enforce_redirect_arm():
+    dp = _engine(model=default_model(
+        ThreatConfig(mode="enforce", redirect_score=100,
+                     redirect_port=14999)), flows=False)
+    stage, recs = _traffic(np.random.default_rng(2), 32, 51000)
+    v, _e, _i, _n = dp.process_packed(stage, now=100)
+    v = np.asarray(v)
+    allowed = _policy_verdict(_identities(recs), recs) == 0
+    score, band, fired = unpack_threat_out(dp.last_threat)
+    should = allowed & (score >= 100)
+    assert should.any()
+    assert (v[should] == 14999).all()
+    assert (np.asarray(dp.last_provenance.tier)[should]
+            == TIER_THREAT_REDIRECT).all()
+
+
+def test_enforce_ratelimit_token_bucket():
+    """Rate-limit band: the identity's bucket admits its burst, then
+    dry-bucket packets drop probabilistically keyed on score."""
+    dp = _engine(model=default_model(
+        ThreatConfig(mode="enforce", ratelimit_score=100,
+                     rate_per_s=0.0, burst=2)), flows=False)
+    dropped = 0
+    passed = 0
+    for batch in range(4):
+        stage, recs = _traffic(np.random.default_rng(3), 64,
+                               52000 + 64 * batch)
+        stage[3] = 52000 + 64 * batch + np.arange(64)  # fresh flows
+        v = np.asarray(dp.process_packed(stage, now=100 + batch)[0])
+        allowed = _policy_verdict(_identities(recs), recs) == 0
+        dropped += int((v[allowed] == VERDICT_DROP_THREAT).sum())
+        passed += int((v[allowed] == 0).sum())
+    assert dropped > 0, "dry bucket must drop"
+    assert passed > 0, "rate-limit is probabilistic, not a blackhole"
+    tiers = np.asarray(dp.last_provenance.tier)
+    v = np.asarray(v)
+    assert (tiers[v == VERDICT_DROP_THREAT]
+            == TIER_THREAT_RATELIMIT).all()
+
+
+# ------------------------------------------- hot swap / config flips
+
+def test_weight_hot_swap_zero_repacks():
+    """A trained same-geometry model pushes through the delta-apply
+    leaf-write path: zero full repacks, no re-jit, and the very next
+    batch scores under the new weights."""
+    dp = _engine(flows=False)
+    stage, _recs = _traffic(np.random.default_rng(4), 16, 53000)
+    dp.process_packed(stage, now=100)
+    s0, _b, _f = unpack_threat_out(dp.last_threat)
+    packs = dp.pack_stats()["full-packs"]
+    writes = dp.pack_stats()["leaf-writes"]
+    zero = linear_model(np.zeros(NUM_FEATURES),
+                        config=ThreatConfig(generation=2))
+    assert dp.apply_threat_weights(zero) is True
+    stats = dp.pack_stats()
+    assert stats["full-packs"] == packs, "weight push repacked"
+    assert stats["leaf-writes"] > writes
+    stage[3] = 54000 + np.arange(16)
+    dp.process_packed(stage, now=101)
+    s1, _b, _f = unpack_threat_out(dp.last_threat)
+    assert (s1 == 0).all() and (s0 > 0).any()
+    assert dp.threat_report()["config"]["generation"] == 2
+
+
+def test_config_flip_is_a_leaf_write():
+    dp = _engine(flows=False)
+    packs = dp.pack_stats()["full-packs"]
+    dp.set_threat_config(ThreatConfig(mode="enforce", drop_score=50))
+    assert dp.pack_stats()["full-packs"] == packs
+    stage, recs = _traffic(np.random.default_rng(5), 16, 55000)
+    v = np.asarray(dp.process_packed(stage, now=100)[0])
+    allowed = _policy_verdict(_identities(recs), recs) == 0
+    assert (v[allowed] == VERDICT_DROP_THREAT).any()
+
+
+# ---------------------------------------------------- disabled path
+
+def test_disabled_path_is_byte_identical():
+    import jax.numpy as jnp
+    base = _engine(threat=False, flows=False)
+    tog = _engine(flows=False)
+    stage = jnp.asarray(np.zeros((10, 16), np.int32))
+    en_txt = tog._step_packed.lower(
+        *tog._lower_args_packed(stage)).as_text()
+    tog.disable_threat()
+    base_txt = base._step_packed.lower(
+        *base._lower_args_packed(stage)).as_text()
+    tog_txt = tog._step_packed.lower(
+        *tog._lower_args_packed(stage)).as_text()
+    assert tog_txt == base_txt
+    assert en_txt != base_txt
+    assert base.dispatch_leaf_counts() == tog.dispatch_leaf_counts()
+
+
+# ------------------------------------------------ sharded isolation
+
+def test_sharded_token_bucket_isolation():
+    """Each shard owns its OWN ThreatState: one shard's window counts
+    and token debt never leak into a sibling's buffer (shard-local,
+    the CT precedent)."""
+    from cilium_tpu.parallel.sharded import ShardedDatapath
+    states = [_policy() for _ in range(4)]
+    p = ShardedDatapath(n_shards=2, ct_slots=1 << 8)
+    p.telemetry_enabled = False
+    p.enable_threat(default_model(
+        ThreatConfig(mode="enforce", ratelimit_score=100,
+                     rate_per_s=0.0, burst=1)), buckets=BUCKETS)
+    p.load_policy(states, revision=1,
+                  ipcache_prefixes={"10.0.0.0/8": HTTP_ID})
+    n = 32
+    recs = {
+        "endpoint": np.zeros(n, np.int32),   # global ep 0 -> shard 0
+        "saddr": np.full(n, (10 << 24) | 5, np.uint32).view(np.int32),
+        "daddr": np.full(n, (10 << 24) | 9, np.uint32).view(np.int32),
+        "sport": (56000 + np.arange(n)).astype(np.int32),
+        "dport": np.full(n, 80, np.int32),
+        "proto": np.full(n, 6, np.int32),
+        "direction": np.zeros(n, np.int32),
+        "tcp_flags": np.full(n, 0x02, np.int32),
+        "length": np.full(n, 100, np.int32),
+        "is_fragment": np.zeros(n, np.int32),
+    }
+    v, _i = p.classify_records(
+        {k: v.copy() for k, v in recs.items()}, n)
+    st0 = np.asarray(p.shards[0].threat_state.state)
+    st1 = np.asarray(p.shards[1].threat_state.state)
+    assert st0.any(), "shard 0 must have scored its traffic"
+    assert not st1.any(), "shard 1's state must be untouched"
+    # now shard 1 (odd endpoints): its state moves, shard 0's frozen
+    recs["endpoint"] = np.ones(n, np.int32)
+    recs["sport"] = (57000 + np.arange(n)).astype(np.int32)
+    p.classify_records(recs, n)
+    st0b = np.asarray(p.shards[0].threat_state.state)
+    st1b = np.asarray(p.shards[1].threat_state.state)
+    assert st1b.any()
+    np.testing.assert_array_equal(st0, st0b)
+    p.serving().close()
+
+
+# --------------------------------------- supervisor fail-static
+
+def test_supervisor_degraded_fail_static_to_policy_verdict():
+    """A tripped device lane serves POLICY verdicts from the host
+    oracle — threat enforcement (which would drop everything here)
+    cannot deny traffic the policy allows while degraded."""
+    from cilium_tpu.datapath.serving import VerdictDispatcher
+    from cilium_tpu.datapath.supervisor import DeviceSupervisor
+    from cilium_tpu.utils.faultinject import (DeviceFaultInjector,
+                                              DeviceLaneFault)
+    dp = _engine(model=default_model(
+        ThreatConfig(mode="enforce", drop_score=1)), flows=False)
+    sup = DeviceSupervisor(dp, watchdog_s=5.0, failure_threshold=1,
+                           reset_s=60.0)
+    disp = VerdictDispatcher(dp, supervisor=sup, lane="threat-chaos")
+    inj = DeviceFaultInjector()
+    sup.install_fault_hook(inj)
+    n = 16
+    recs = {
+        "endpoint": np.zeros(n, np.int32),
+        "saddr": np.full(n, (10 << 24) | 5, np.uint32).view(np.int32),
+        "daddr": np.full(n, (10 << 24) | 9, np.uint32).view(np.int32),
+        "sport": (58000 + np.arange(n)).astype(np.int32),
+        "dport": np.full(n, 80, np.int32),
+        "proto": np.full(n, 6, np.int32),
+        "direction": np.zeros(n, np.int32),
+        "tcp_flags": np.full(n, 0x02, np.int32),
+        "length": np.full(n, 100, np.int32),
+        "is_fragment": np.zeros(n, np.int32),
+    }
+    # on-device: the enforce model drops the allowed traffic
+    t = disp.submit_records({k: v.copy() for k, v in recs.items()}, n)
+    v, _i = t.result(timeout=60)
+    assert (v == VERDICT_DROP_THREAT).all()
+    # trip the lane: fail-static answers the POLICY verdict (allow)
+    inj.fail_launch(times=4, fatal=True)
+    recs["sport"] = (59000 + np.arange(n)).astype(np.int32)
+    t2 = disp.submit_records(recs, n)
+    v2, _i2 = t2.result(timeout=60)
+    assert sup.mode == "degraded"
+    assert (v2 == 0).all(), \
+        "degraded lane must fail static to the policy verdict"
+    disp.close()
+
+
+# ------------------------------------------------ live-daemon journey
+
+def test_live_daemon_threat_journey(tmp_path):
+    """train -> push -> status -> flight recorder: the full operator
+    loop on a live agent with the threat plane enabled in shadow."""
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.utils.option import DaemonConfig
+    from cilium_tpu.observability.events import recorder
+    from cilium_tpu.utils.metrics import THREAT_VERDICTS
+    d = Daemon(config=DaemonConfig(
+        state_dir="", drift_audit_interval_s=0,
+        ct_checkpoint_interval_s=0, enable_threat=True,
+        enable_provenance=True))
+    server = APIServer(d).start()
+    try:
+        assert d.status()["threat"]["mode"] == "shadow"
+        # traffic through the fused pipeline populates the flow plane
+        stage, recs = _traffic(np.random.default_rng(7), 64, 60000)
+        v, e, ident, _nat = d.datapath.process_packed(stage, now=100)
+        prov = d.datapath.last_provenance
+        base_scored = THREAT_VERDICTS.value(
+            labels={"outcome": "scored"})
+        d.monitor.ingest_batch(
+            np.asarray(e), recs["endpoint"], np.asarray(ident),
+            recs["dport"], recs["proto"], recs["length"],
+            tiers=np.asarray(prov.tier),
+            match_slots=np.asarray(prov.match_slot),
+            threat_out=np.asarray(d.datapath.last_threat))
+        assert THREAT_VERDICTS.value(
+            labels={"outcome": "scored"}) - base_scored == 64
+        # train from the aggregated flow plane + hot-swap push
+        out = d.threat_train(max_flows=1024)
+        assert out["training"]["flows"] > 0
+        assert out["push"]["hot-swap"] is True
+        gen = out["push"]["generation"]
+        assert gen >= 2
+        # flight recorder carries the push event
+        types = [ev.type for ev in recorder.events(limit=0)]
+        assert "threat-model-push" in types
+        # REST: status + config flip to enforce -> mode-flip event
+        from cilium_tpu.cli import Client
+        c = Client(f"http://127.0.0.1:{server.port}")
+        got = c.get("/threat")
+        assert got["model"]["config"]["generation"] == gen
+        c.post("/threat/config", {"mode": "enforce",
+                                  "drop-score": 250})
+        st = d.status()["threat"]
+        assert st["mode"] == "enforce"
+        assert st["status"].startswith("ENFORCING")
+        flips = [ev for ev in recorder.events(limit=0)
+                 if ev.type == "threat-mode"]
+        assert flips and flips[-1].attrs["mode"] == "enforce"
+        # back to shadow: verdicts bit-exact again
+        c.post("/threat/config", {"mode": "shadow"})
+        assert d.status()["threat"]["mode"] == "shadow"
+    finally:
+        server.shutdown()
+        d.shutdown()
+
+
+# --------------------------------------------------- grammar / misc
+
+def test_tier_grammar_and_event_mapping():
+    from cilium_tpu.hubble.filter import parse_tier
+    from cilium_tpu.hubble.flow import (VERDICT_DROPPED,
+                                        verdict_of_event)
+    assert parse_tier("threat-drop") == "threat-drop"
+    assert parse_tier(TIER_THREAT_RATELIMIT) == "threat-ratelimit"
+    assert TIER_NAMES[TIER_THREAT_REDIRECT] == "threat-redirect"
+    assert verdict_of_event(DROP_THREAT) == VERDICT_DROPPED
+
+
+def test_trainer_separates_drop_flows():
+    """The numpy trainer learns to score drop-event flows above
+    allowed flows, and the quantized model preserves the ordering."""
+    rng = np.random.default_rng(42)
+    flows = []
+    for i in range(200):
+        bad = i % 2 == 0
+        flows.append({
+            "src-identity": WORLD if bad else HTTP_ID,
+            "dst-identity": EP_IDENTITY,
+            "dport": int(rng.integers(1, 65536)) if bad else 80,
+            "proto": 6,
+            "event": -130 if bad else 0,
+            "packets": int(rng.integers(1, 4)) if bad
+            else int(rng.integers(50, 500)),
+            "bytes": int(rng.integers(40, 200)) if bad
+            else int(rng.integers(5000, 50000)),
+            "last-seen": 100})
+    trainer = ThreatTrainer()
+    model = trainer.fit(flows, now=100)
+    assert trainer.last_report["train-accuracy"] >= 0.9
+    from cilium_tpu.threat.trainer import features_from_flow
+    bad_scores = model.score(np.stack(
+        [features_from_flow(f, 100) for f in flows[0::2]]))
+    good_scores = model.score(np.stack(
+        [features_from_flow(f, 100) for f in flows[1::2]]))
+    assert bad_scores.mean() > good_scores.mean() + 20
