@@ -161,6 +161,36 @@ def _smoke_result():
                                "post_push_batch_ms": 56.0,
                                "no_serving_pause": True},
                   "threat_disabled_byte_identical": True}}
+    # the analytics-overhead config's pinned output schema: fused
+    # sketch-plane overhead vs the pre-analytics program, the mid-
+    # serving epoch swap, the attack-shape decode leg, and the
+    # disabled-path byte-identity gate
+    suite["analytics-overhead"] = {
+        "metric": "analytics_overhead_verdicts_per_sec",
+        "value": 1_120_000, "unit": "verdicts/s",
+        "vs_baseline": 0.112,
+        "extra": {"smoke": True, "batch": 65536, "rounds": 5,
+                  "baseline_vps": 1_180_000,
+                  "analytics_vps": 1_120_000,
+                  "overhead_pct": 5.1,
+                  "gate_overhead_le_10pct": True,
+                  "geometry": {"width": 4096, "depth": 2,
+                               "lanes": 4, "stripe": 16},
+                  "epoch_swap": {"swap_ms": 0.9,
+                                 "pre_swap_batch_ms": 55.0,
+                                 "post_swap_batch_ms": 56.0,
+                                 "no_serving_pause": True},
+                  "attack": {"attacker_identity": 256,
+                             "legit_rows": 3072, "scan_rows": 512,
+                             "syn_flood_rows": 512,
+                             "top_talker_identity": 256,
+                             "top_talker_bytes": 798720,
+                             "gate_top_talker_named_attacker": True,
+                             "scan_suspects": [256],
+                             "scan_suspect_dports": 512,
+                             "gate_scan_view_fired": True,
+                             "top_spreader_identity": 256},
+                  "analytics_disabled_byte_identical": True}}
     # the overload config's pinned output schema: per-multiplier legs
     # with accepted-latency percentiles + shed accounting, admission
     # control vs the unbounded pre-change queue
@@ -542,7 +572,7 @@ def run_bench():
                      "l7-fast",
                      "capacity", "incremental", "flows-overhead",
                      "tracing-overhead", "provenance-overhead",
-                     "threat-score",
+                     "threat-score", "analytics-overhead",
                      "control-churn"):
             if time.perf_counter() > deadline:
                 suite[name] = "skipped: time budget"

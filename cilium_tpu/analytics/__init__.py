@@ -1,0 +1,8 @@
+"""Device-resident traffic analytics: count-min heavy-hitter
+sketches, candidate key tables, and distinct-flow cardinality
+registers fused into the verdict pipelines (``stage``), with the
+bit-exact numpy twin (``oracle``) and the host-side top-K decoder
+(``decode``)."""
+
+from .stage import (AnalyticsState, analytics_stage,  # noqa: F401
+                    make_analytics_state)

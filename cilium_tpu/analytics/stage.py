@@ -1,0 +1,247 @@
+"""The fused device-resident traffic-analytics stage (jnp).
+
+Runs INSIDE both jitted family pipelines behind the static
+``with_analytics`` gate (datapath/pipeline.py), after the final
+verdict: every batch folds its traffic into three count-min sketches
+(heavy-hitter bytes/packets/drops keyed by src identity, by
+(identity, dport), and by dst /24 prefix), a bank of per-identity
+distinct-flow cardinality registers (integer hash-max lanes, KMV
+style), and per-keyspace candidate key tables the host-side top-K
+decoder (``decode.py``) queries against — the Taurus/hXDP point that
+per-packet aggregation belongs inside the dataplane program, not in a
+sampled collector.
+
+Cost shape: the whole plane is ONE [R, W] int32 buffer (one jitted-
+step leaf), and a batch lands as one scatter-add per sketch (metric
+and hash-row contributions flattened into a single index vector) plus
+one combined max-scatter for the key tables + cardinality registers.
+``stripe`` samples the update slice exactly like the threat stage's
+window aggregates (1-in-N rotating contiguous block, phase from
+``now``), so heavy-hitter ordering survives while the scatter volume
+stays bounded.
+
+Epoching: the buffer holds TWO complete copies of every section (A/B)
+plus a control row whose cell 0 names the epoch currently being
+written.  The stage reads that cell *dynamically* — an epoch swap is
+a control-plane write of one cell (engine.swap_analytics_epoch), never
+a re-jit — so host decodes read the quiesced epoch while the serving
+lane keeps folding batches into the other.
+
+Determinism contract: sketch updates are commutative adds (a masked
+row contributes value 0, a true no-op), key tables and registers are
+order-free max scatters, and all arithmetic is int32 — so the numpy
+oracle (``oracle.py``) reproduces the device buffer bit-exactly; the
+parity tests in tests/test_analytics.py hold that line.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..ops.hashtab_ops import hash_mix_jnp
+
+# keyspaces (one count-min sketch + one candidate key table each)
+KS_IDENTITY = 0     # talkers: src security identity
+KS_PORT = 1         # scanners: (identity, dport) pairs
+KS_PREFIX = 2       # dst /24 prefix heavy hitters
+N_KEYSPACES = 3
+
+# metrics tracked per sketch (the D hash rows repeat per metric)
+MET_BYTES = 0
+MET_PACKETS = 1
+MET_DROPS = 2
+N_METRICS = 3
+
+# hash salts (fixed constants; the oracle and decoder share them)
+SKETCH_SALT = 0x53C7
+KEYTAB_SALT = 0x5EED
+REG_SALT = 0x0CA8
+LANE_SALT = 0x1A7E
+
+# the epoch-selector cell: state[ctrl_row(...), CTRL_COL]
+CTRL_COL = 0
+
+
+def sketch_salt(k: int, d: int) -> int:
+    """Per-(keyspace, hash-row) sketch column salt."""
+    return (SKETCH_SALT + 0x101 * (k * 31 + d)) & 0x7FFFFFFF
+
+
+def keytab_salt(k: int) -> int:
+    """Per-keyspace candidate-key-table column salt."""
+    return (KEYTAB_SALT + 0x101 * k) & 0x7FFFFFFF
+
+
+def lane_salt(lane: int) -> int:
+    """Per-lane cardinality-register value salt."""
+    return (LANE_SALT + 0x101 * lane) & 0x7FFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Buffer geometry: one epoch section stacks, top to bottom,
+#   [N_KEYSPACES * N_METRICS * depth]  count-min sketch rows
+#   [N_KEYSPACES]                      candidate key tables (1 row each)
+#   [lanes]                            cardinality hash-max registers
+# and the full buffer is two epoch sections + the control row.
+# ---------------------------------------------------------------------------
+
+def epoch_rows(depth: int, lanes: int) -> int:
+    return N_KEYSPACES * N_METRICS * depth + N_KEYSPACES + lanes
+
+
+def sketch_row(k: int, m: int, d: int, depth: int) -> int:
+    """Row (within an epoch section) of sketch hash-row ``d`` of
+    metric ``m`` in keyspace ``k``."""
+    return (k * N_METRICS + m) * depth + d
+
+
+def keytab_row(k: int, depth: int) -> int:
+    return N_KEYSPACES * N_METRICS * depth + k
+
+
+def reg_row(lane: int, depth: int) -> int:
+    return N_KEYSPACES * N_METRICS * depth + N_KEYSPACES + lane
+
+
+def ctrl_row(depth: int, lanes: int) -> int:
+    return 2 * epoch_rows(depth, lanes)
+
+
+def total_rows(depth: int, lanes: int) -> int:
+    return 2 * epoch_rows(depth, lanes) + 1
+
+
+class AnalyticsState(NamedTuple):
+    """The shard-local mutable analytics buffer: ONE [R, W] int32
+    dispatch leaf (both epoch sections + the control row), owned per
+    engine like the threat state — each mesh shard folds its own
+    traffic into its own copy (specs.ANALYTICS_STATE_SPECS), and the
+    mesh-wide answer merges shards by add (sketches) / max (key
+    tables, registers) host-side."""
+
+    state: jnp.ndarray
+
+
+def make_analytics_state(width: int, depth: int = 2,
+                         lanes: int = 4) -> AnalyticsState:
+    assert width & (width - 1) == 0, "width must be a power of 2"
+    return AnalyticsState(state=jnp.zeros(
+        (total_rows(depth, lanes), width), jnp.int32))
+
+
+def flow_hash_keys(identity, dport, daddr_key):
+    """The three non-negative int32 sketch/key-table keys of a batch
+    row: src identity, the packed (identity, dport) pair, and the dst
+    /24 prefix of the (DNAT'd) destination word.  Shared with the
+    oracle and decoder so the same encoding round-trips."""
+    k_id = identity & jnp.int32(0x7FFFFFFF)
+    k_port = ((identity & jnp.int32(0x7FFF)) << 16) | \
+        (dport & jnp.int32(0xFFFF))
+    k_pref = (daddr_key >> 8) & jnp.int32(0x00FFFFFF)
+    return k_id, k_port, k_pref
+
+
+def analytics_stage(analytics: AnalyticsState, *, identity, dport,
+                    proto, sport, length, verdict, saddr_key,
+                    daddr_key, now, depth: int, lanes: int,
+                    stripe: int = 16) -> AnalyticsState:
+    """One fused analytics pass over [B] int32 lanes.  ``saddr_key``/
+    ``daddr_key`` are the address words entering the flow hash (v4
+    passes the raw words, v6 its CT folds); ``verdict`` is FINAL
+    (post-threat), so the drops metric attributes every drop arm.
+
+    ``stripe`` (static) samples the update slice: each batch folds one
+    rotating contiguous 1/stripe block of its rows (phase from
+    ``now``), the threat-stage precedent.  stripe=1 folds every row.
+    Deterministic either way — the oracle mirrors the phase.  The
+    stage's cost is scatter-element-bound, so it scales with the
+    sampled fraction: stripe is the serving overhead budget (the
+    1-in-16 default holds the analytics-overhead bench gate)."""
+    state = analytics.state
+    width = state.shape[1]
+    cmask = jnp.int32(width - 1)
+    er = epoch_rows(depth, lanes)
+    b = identity.shape[0]
+    now_i = jnp.int32(now)
+
+    # the write epoch, read dynamically from the control cell: a swap
+    # is a host-side cell write, never a recompile
+    base = state[ctrl_row(depth, lanes), CTRL_COL] * jnp.int32(er)
+
+    st_n = max(1, min(stripe, b))
+    w = b // st_n if b % st_n == 0 else b
+
+    def _sl(x):
+        if w == b:
+            return x
+        from jax import lax as _lax
+        phase = jnp.remainder(now_i, jnp.int32(st_n))
+        return _lax.dynamic_slice_in_dim(x, phase * w, w)
+
+    ids = _sl(identity)
+    dps = _sl(dport)
+    prs = _sl(proto)
+    sps = _sl(sport)
+    lns = _sl(length)
+    vds = _sl(verdict)
+    sas = _sl(saddr_key)
+    das = _sl(daddr_key)
+
+    keys = flow_hash_keys(ids, dps, das)
+
+    # -- count-min sketches: ONE scatter-add per keyspace ---------------
+    # metric values ([w, M]): bytes, packets, and drops (0 for allowed
+    # rows — a value-0 add is a true no-op, so no sentinel is needed)
+    one = jnp.ones_like(lns)
+    vals = jnp.stack([lns, one, jnp.where(vds < 0, one,
+                                          jnp.zeros_like(one))], axis=1)
+    for k in range(N_KEYSPACES):
+        cols = jnp.stack([
+            hash_mix_jnp(keys[k], jnp.full((w,), sketch_salt(k, d),
+                                           jnp.int32)) & cmask
+            for d in range(depth)], axis=1)          # [w, D]
+        rows = base + jnp.asarray(
+            [[sketch_row(k, m, d, depth) for d in range(depth)]
+             for m in range(N_METRICS)], jnp.int32)  # [M, D]
+        r = jnp.broadcast_to(rows[None, :, :],
+                             (w, N_METRICS, depth)).reshape(-1)
+        c = jnp.broadcast_to(cols[:, None, :],
+                             (w, N_METRICS, depth)).reshape(-1)
+        v = jnp.broadcast_to(vals[:, :, None],
+                             (w, N_METRICS, depth)).reshape(-1)
+        state = state.at[r, c].add(v)
+
+    # -- candidate key tables + cardinality registers: one combined ----
+    # max-scatter.  Key tables keep the largest key hashing into each
+    # slot (order-free; any persistent heavy hitter claims its slot);
+    # registers keep the per-lane max of the flow-tuple hash under the
+    # identity's bucket column — duplicate packets of a flow are
+    # idempotent, so the lane maxima encode distinct-flow counts.
+    word = ((sps & jnp.int32(0xFFFF)) << 16) | (dps & jnp.int32(0xFFFF))
+    fh = hash_mix_jnp(hash_mix_jnp(sas, das),
+                      hash_mix_jnp(word, prs))
+    reg_col = hash_mix_jnp(ids, jnp.full((w,), REG_SALT,
+                                         jnp.int32)) & cmask
+    mx_rows = []
+    mx_cols = []
+    mx_vals = []
+    for k in range(N_KEYSPACES):
+        mx_rows.append(jnp.broadcast_to(
+            base + jnp.int32(keytab_row(k, depth)), (w,)))
+        mx_cols.append(hash_mix_jnp(
+            keys[k], jnp.full((w,), keytab_salt(k), jnp.int32)) & cmask)
+        mx_vals.append(keys[k])
+    for lane in range(lanes):
+        mx_rows.append(jnp.broadcast_to(
+            base + jnp.int32(reg_row(lane, depth)), (w,)))
+        mx_cols.append(reg_col)
+        mx_vals.append(hash_mix_jnp(
+            fh, jnp.full((w,), lane_salt(lane), jnp.int32))
+            & jnp.int32(0x7FFFFFFF))
+    state = state.at[jnp.concatenate(mx_rows),
+                     jnp.concatenate(mx_cols)].max(
+        jnp.concatenate(mx_vals))
+
+    return AnalyticsState(state=state)

@@ -91,6 +91,15 @@ def _print_json(obj) -> None:
     print(json.dumps(obj, indent=2, sort_keys=True))
 
 
+def _follow_sleep(interval: float, drained: bool) -> None:
+    """Pace a follow-mode poll loop.  A busy emitter must NOT turn
+    the follower into a hot spin: when the last poll returned events
+    the next one fires sooner, but still floored at a fraction of
+    --interval so an always-busy ring costs bounded CPU instead of a
+    zero-sleep tight loop against the agent API."""
+    time.sleep(interval if drained else max(0.02, interval / 20.0))
+
+
 # ------------------------------------------------------------- subcommands
 
 def cmd_status(c: Client, args) -> int:
@@ -506,7 +515,7 @@ def cmd_monitor(c: Client, args) -> int:
                 print(e["message"])
             if not args.follow:
                 return 0
-            time.sleep(args.interval if not events else 0)
+            _follow_sleep(args.interval, not events)
     except KeyboardInterrupt:
         return 0
 
@@ -574,7 +583,7 @@ def cmd_hubble(c: Client, args) -> int:
                       "unavailable or degraded)", file=sys.stderr)
             if not args.follow:
                 return 0
-            time.sleep(args.interval if not flows else 0)
+            _follow_sleep(args.interval, not flows)
     except KeyboardInterrupt:
         return 0
 
@@ -621,7 +630,7 @@ def cmd_events(c: Client, args) -> int:
                           f"{stats.get('ringed', 0)} buffered, "
                           f"{stats.get('evicted', 0)} evicted)")
                 return 0
-            time.sleep(args.interval if not events else 0)
+            _follow_sleep(args.interval, not events)
     except KeyboardInterrupt:
         return 0
 
@@ -719,6 +728,50 @@ def cmd_threat(c: Client, args) -> int:
     # train
     _print_json(c.post("/threat/train",
                        {"max_flows": args.max_flows}))
+    return 0
+
+
+def cmd_top(c: Client, args) -> int:
+    """``cilium-tpu top`` — mesh-wide traffic analytics decoded from
+    the device-resident sketches (GET /analytics/top): talkers
+    (heavy-hitter identities by bytes/packets/drops), scanners
+    (distinct-dport fan-out per identity, scan suspects flagged),
+    spreaders (distinct-flow cardinality per identity)."""
+    from urllib.parse import urlencode
+    qs = urlencode({"view": args.view, "n": str(args.n),
+                    "metric": args.metric})
+    out = c.get(f"/analytics/top?{qs}")
+    if args.json:
+        _print_json(out)
+        return 0
+    entries = out.get("entries", [])
+    view = out.get("view", args.view)
+    if view == "scanners":
+        print(f"{'IDENTITY':<12} {'DPORTS':>8} {'PACKETS':>10}  FLAG")
+        for e in entries:
+            flag = "SCAN-SUSPECT" if e.get("suspect") else "-"
+            print(f"{e['identity']:<12} {e['dports']:>8} "
+                  f"{e['packets']:>10}  {flag}")
+    elif view == "spreaders":
+        print(f"{'IDENTITY':<12} {'FLOWS':>10}")
+        for e in entries:
+            print(f"{e['identity']:<12} {e['flows']:>10}")
+    else:  # talkers
+        metric = out.get("metric", args.metric)
+        print(f"{'IDENTITY':<12} {metric.upper():>14}")
+        for e in entries:
+            print(f"{e['identity']:<12} {e['count']:>14}")
+    if not entries:
+        print("(no traffic decoded in the quiesced epoch)")
+    if out.get("partial"):
+        bad = sorted(k for k, s in (out.get("shards") or {}).items()
+                     if s.get("status") != "ok")
+        # fail-open: the remaining shards still answered, but this
+        # top-K is missing the degraded shards' traffic — say so
+        # loudly instead of presenting a partial decode as the truth
+        print(f"(PARTIAL result: analytics shard(s) "
+              f"{', '.join(bad)} unreadable — their traffic is "
+              f"missing from this view)", file=sys.stderr)
     return 0
 
 
@@ -1129,6 +1182,21 @@ def build_parser() -> argparse.ArgumentParser:
     tt.add_argument("--max-flows", dest="max_flows", type=int,
                     default=4096)
 
+    top = sub.add_parser("top",
+                         help="device-resident traffic analytics: "
+                              "heavy-hitter / scan / cardinality "
+                              "views (/analytics/top)")
+    top.add_argument("view", nargs="?", default="talkers",
+                     choices=["talkers", "scanners", "spreaders"],
+                     help="talkers = identities by sketch count, "
+                          "scanners = distinct-dport fan-out, "
+                          "spreaders = distinct-flow cardinality")
+    top.add_argument("-n", type=int, default=10)
+    top.add_argument("--metric", default="bytes",
+                     choices=["bytes", "packets", "drops"],
+                     help="talkers ranking metric")
+    top.add_argument("--json", action="store_true")
+
     cfgp = sub.add_parser("config", help="daemon options")
     cfgp.add_argument("options", nargs="*", help="Option=value")
 
@@ -1237,7 +1305,7 @@ COMMANDS = {
     "status": cmd_status, "policy": cmd_policy, "endpoint": cmd_endpoint,
     "identity": cmd_identity, "service": cmd_service,
     "prefilter": cmd_prefilter, "monitor": cmd_monitor,
-    "hubble": cmd_hubble, "threat": cmd_threat,
+    "hubble": cmd_hubble, "threat": cmd_threat, "top": cmd_top,
     "config": cmd_config, "metrics": cmd_metrics,
     "trace": cmd_trace, "events": cmd_events,
     "bugtool": cmd_bugtool, "cni": cmd_cni,

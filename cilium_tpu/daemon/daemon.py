@@ -353,6 +353,28 @@ class Daemon:
                 model, buckets=self.config.threat_buckets,
                 window_s=self.config.threat_window_s)
             THREAT_MODEL_GENERATION.set(model.config.generation)
+        # device-resident traffic analytics (cilium_tpu/analytics/):
+        # count-min sketches + cardinality registers fused into both
+        # family pipelines; the drain controller swaps the A/B epoch,
+        # decodes the quiesced section into the capped top-K byte
+        # gauge, and rings heavy-hitter / scan-suspect transitions
+        # into the incident flight recorder
+        self._analytics_hh_live: set = set()
+        self._analytics_scan_live: set = set()
+        self._analytics_exported: set = set()
+        self._analytics_last: Optional[Dict] = None
+        if self.config.enable_analytics:
+            self.datapath.enable_analytics(
+                width=self.config.analytics_width,
+                depth=self.config.analytics_depth,
+                lanes=self.config.analytics_lanes,
+                stripe=self.config.analytics_stripe)
+            if self.config.analytics_drain_interval_s > 0:
+                self.controllers.update_controller(
+                    "analytics-drain", ControllerParams(
+                        do_func=self.analytics_drain,
+                        run_interval=self.config
+                        .analytics_drain_interval_s))
         self._drift_report: Optional[Dict] = None
         self._last_replay: Optional[Dict] = None
         self._drift_rng = np.random.default_rng(0xC111)
@@ -1172,6 +1194,165 @@ class Daemon:
         return {"training": self._threat_trainer.last_report,
                 "push": push}
 
+    # ------------------------------------- device traffic analytics
+
+    def _analytics_sections(self, swap: bool) -> Optional[Dict]:
+        """One decoded-epoch fetch shaped like the sharded answer for
+        both dataplane shapes: the sharded datapath merges per-shard
+        sections behind per-shard breakers (fail-open); the single
+        engine swaps + snapshots locally."""
+        dp = self.datapath
+        if hasattr(dp, "analytics_sections"):
+            return dp.analytics_sections(swap=swap)
+        from ..analytics.decode import epoch_section, quiesced_section
+        report = dp.analytics_report()
+        if report is None:
+            return None
+        depth, lanes = report["depth"], report["lanes"]
+        if swap:
+            epoch = dp.swap_analytics_epoch()
+            section = epoch_section(dp.analytics_snapshot(), epoch,
+                                    depth, lanes)
+        else:
+            section = quiesced_section(dp.analytics_snapshot(), depth,
+                                       lanes)
+        return {"sections": [section], "shards": {"0": {"status": "ok"}},
+                "partial": False, "depth": depth, "lanes": lanes}
+
+    def analytics_drain(self) -> Dict:
+        """The analytics-drain controller body: flip the device A/B
+        epoch, decode the newly quiesced section, export the
+        capped-cardinality ``analytics_top_bytes{identity}`` gauge,
+        and ring heavy-hitter / scan-suspect THRESHOLD TRANSITIONS
+        into the flight recorder (edge-triggered per identity — a
+        sustained hitter is one event, not one per drain)."""
+        from ..analytics.decode import (merge_sections, top_scanners,
+                                        top_talkers)
+        from ..observability.events import (EVENT_TRAFFIC_HEAVY_HITTER,
+                                            EVENT_TRAFFIC_SCAN_SUSPECT)
+        from ..utils.metrics import (ANALYTICS_DRAINS,
+                                     ANALYTICS_SCAN_SUSPECTS,
+                                     ANALYTICS_TOP_BYTES)
+        secs = self._analytics_sections(swap=True)
+        if secs is None:
+            return {"status": "off"}
+        k = self.config.analytics_top_k
+        result = "partial" if secs["partial"] else "ok"
+        ANALYTICS_DRAINS.inc(labels={"result": result})
+        if not secs["sections"]:
+            out = {"status": result, "shards": secs["shards"],
+                   "top": [], "suspects": []}
+            with self._lock:
+                self._analytics_last = out
+            return out
+        merged = merge_sections(secs["sections"], secs["depth"],
+                                secs["lanes"])
+        top = top_talkers(merged, secs["depth"], k=k, metric="bytes")
+        total = sum(e["count"] for e in top) or 1
+        # capped-cardinality export: only the CURRENT top-K identities
+        # carry a live series; evicted ones zero out, so the label set
+        # never grows past k live values under identity churn
+        current = {e["identity"] for e in top}
+        for ident in self._analytics_exported - current:
+            ANALYTICS_TOP_BYTES.set(0, labels={"identity": str(ident)})
+        for e in top:
+            ANALYTICS_TOP_BYTES.set(
+                e["count"], labels={"identity": str(e["identity"])})
+        self._analytics_exported = current
+        # heavy-hitter share transitions (edge-triggered per identity)
+        share_bar = self.config.analytics_hh_share
+        hitters = {e["identity"]: e for e in top
+                   if e["count"] / total >= share_bar}
+        for ident in set(hitters) - self._analytics_hh_live:
+            e = hitters[ident]
+            flight_recorder.record(
+                EVENT_TRAFFIC_HEAVY_HITTER,
+                f"identity {ident} at "
+                f"{e['count'] / total:.0%} of epoch bytes",
+                identity=ident, share=round(e["count"] / total, 3),
+                bytes=e["count"])
+        self._analytics_hh_live = set(hitters)
+        # scan-suspect transitions from the (identity, dport) view
+        scans = top_scanners(merged, secs["depth"], k=k,
+                             min_dports=self.config.analytics_scan_ports)
+        suspects = {e["identity"]: e for e in scans if e["suspect"]}
+        ANALYTICS_SCAN_SUSPECTS.set(len(suspects))
+        for ident in set(suspects) - self._analytics_scan_live:
+            e = suspects[ident]
+            flight_recorder.record(
+                EVENT_TRAFFIC_SCAN_SUSPECT,
+                f"identity {ident} touched {e['dports']} distinct "
+                f"dports in one epoch",
+                identity=ident, ports=e["dports"],
+                packets=e["packets"])
+        self._analytics_scan_live = set(suspects)
+        out = {"status": result, "shards": secs["shards"], "top": top,
+               "suspects": sorted(suspects)}
+        with self._lock:
+            self._analytics_last = out
+        return out
+
+    def analytics_top(self, view: str = "talkers", k: int = 10,
+                      metric: str = "bytes") -> Dict:
+        """GET /analytics/top / ``cilium-tpu top``: one mesh-wide
+        top-K answer decoded from the QUIESCED epoch sections (no
+        swap — reads race nothing and serving never pauses).  Raises
+        KeyError when analytics is not enabled or the view/metric is
+        unknown."""
+        from ..analytics.decode import (METRICS, VIEWS, decode_view,
+                                        merge_sections)
+        from ..utils.metrics import ANALYTICS_QUERIES
+        if view not in VIEWS:
+            raise KeyError(f"unknown analytics view {view!r} "
+                           f"(expected one of {VIEWS})")
+        if metric not in METRICS:
+            raise KeyError(f"unknown analytics metric {metric!r} "
+                           f"(expected one of {tuple(METRICS)})")
+        secs = self._analytics_sections(swap=False)
+        if secs is None:
+            raise KeyError("traffic analytics not enabled")
+        if secs["sections"]:
+            merged = merge_sections(secs["sections"], secs["depth"],
+                                    secs["lanes"])
+            entries = decode_view(merged, view, secs["depth"],
+                                  secs["lanes"], k=k, metric=metric)
+        else:
+            entries = []
+        out = {"view": view, "metric": metric, "entries": entries,
+               "partial": secs["partial"], "shards": secs["shards"]}
+        ANALYTICS_QUERIES.inc(labels={
+            "view": view,
+            "result": "partial" if out["partial"] else "ok"})
+        return out
+
+    def analytics_status(self) -> Dict:
+        """status()["analytics"] / GET /analytics: geometry + write
+        epoch, the last drain's outcome, and live anomaly counts.  A
+        partial drain reports loudly — the mesh-wide decode is missing
+        a shard's traffic (fail-open, the federation precedent)."""
+        report = self.datapath.analytics_report() \
+            if hasattr(self.datapath, "analytics_report") else None
+        if report is None:
+            # "status" stays present so the loudness lint counts the
+            # section as a covered degraded-signal surface
+            return {"enabled": False, "status": "off"}
+        with self._lock:
+            last = self._analytics_last
+        out = {"enabled": True, "report": report,
+               "last-drain": last,
+               "heavy-hitters": sorted(self._analytics_hh_live),
+               "scan-suspects": sorted(self._analytics_scan_live)}
+        if last is not None and last.get("status") == "partial":
+            bad = [k for k, s in (last.get("shards") or {}).items()
+                   if s.get("status") != "ok"]
+            out["status"] = (
+                f"PARTIAL: analytics shard(s) {bad} unreadable — "
+                f"mesh-wide top-K decode is missing their traffic "
+                f"(remaining shards still answer, fail-open)")
+        else:
+            out["status"] = "ok"
+        return out
+
     # -------------------------------------------------- regeneration
 
     def _regenerate_endpoint(self, ep: Endpoint) -> None:
@@ -1669,6 +1850,10 @@ class Daemon:
             # enforcing plane reports loudly (a model may now override
             # policy-allowed traffic)
             "threat": self.threat_status(),
+            # device traffic analytics: sketch geometry + write epoch,
+            # the last drain's (possibly partial) outcome, and the
+            # live heavy-hitter / scan-suspect sets
+            "analytics": self.analytics_status(),
             # runtime capability probes (bpf/run_probes.sh analog)
             "features": self._features(),
         }

@@ -185,6 +185,26 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._error(404, "threat scoring disabled")
                 except ValueError as e:
                     return self._error(400, str(e))
+            if path == "/analytics" and method == "GET":
+                # device traffic analytics: geometry + write epoch,
+                # last drain outcome, live anomaly sets
+                # (daemon.analytics_status)
+                return self._send(200, d.analytics_status())
+            if path == "/analytics/top" and method == "GET":
+                # mesh-wide top-K over the quiesced sketch epoch:
+                # ?view=talkers|scanners|spreaders, ?metric=bytes|
+                # packets|drops, ?n=<k>.  A degraded shard flags the
+                # answer partial (fail-open), never a hang.
+                try:
+                    return self._send(200, d.analytics_top(
+                        view=qs.get("view", ["talkers"])[0],
+                        k=int(qs.get("n", ["10"])[0]),
+                        metric=qs.get("metric", ["bytes"])[0]))
+                except KeyError as e:
+                    msg = str(e.args[0]) if e.args else str(e)
+                    if "not enabled" in msg:
+                        return self._error(404, msg)
+                    return self._error(400, msg)
             if path == "/debug/drift-audit" and method == "POST":
                 # on-demand drift-audit sweep (the periodic
                 # controller's body): replay sampled tuples through

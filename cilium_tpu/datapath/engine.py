@@ -194,6 +194,18 @@ class Datapath:
         # window-aggregate update stripe (threat/stage.py): 1-in-N
         # sampled scatters, the flow table's ls_stripe precedent
         self._threat_stripe = 4
+        # device-resident traffic analytics (analytics/): when on,
+        # both family steps fuse the sketch/register stage over the
+        # shard-local AnalyticsState buffer (two A/B epoch sections +
+        # the control row — a pure engine-owned state leaf like the
+        # threat state, no table leaves join the pack).  Off = the
+        # exact pre-analytics compiled program.
+        self._analytics_on = False
+        self.analytics_state = None   # analytics/stage.AnalyticsState
+        self._analytics_width = 1 << 12
+        self._analytics_depth = 2
+        self._analytics_lanes = 4
+        self._analytics_stripe = 16
 
     @property
     def counters(self) -> Optional[Counters]:
@@ -386,6 +398,101 @@ class Datapath:
                 (st[:-1, COL_WIN_TS] != 0).sum())
         return out
 
+    # -- device-resident traffic analytics (analytics/) ----------------------
+
+    def enable_analytics(self, width: int = 1 << 12, depth: int = 2,
+                         lanes: int = 4, stripe: int = 16) -> None:
+        """Turn on the fused traffic-analytics stage: both family
+        steps fold every batch's final verdicts into the shard-local
+        AnalyticsState buffer (count-min heavy-hitter sketches,
+        candidate key tables, distinct-flow cardinality registers —
+        analytics/stage.py).  ``width`` is the per-row column count
+        (power of 2); ``stripe`` the 1-in-N update sampling.  The
+        fused cost is scatter-element-bound and scales with the
+        sampled fraction, so ``stripe`` IS the overhead budget: the
+        1-in-16 default holds the fused step within the serving
+        overhead gate (bench ``analytics-overhead``); stripe=1 folds
+        every row when exactness beats throughput."""
+        from ..analytics.stage import make_analytics_state
+        with self._lock:
+            self._analytics_on = True
+            self._analytics_width = width
+            self._analytics_depth = depth
+            self._analytics_lanes = lanes
+            self._analytics_stripe = stripe
+            self.analytics_state = make_analytics_state(width, depth,
+                                                        lanes)
+            if self._replicated_sharding is not None:
+                self.analytics_state = jax.device_put(
+                    self.analytics_state, self._replicated_sharding)
+            if self._step is not None:
+                self._rebuild()
+
+    def disable_analytics(self) -> None:
+        """Back to the exact pre-analytics compiled program."""
+        with self._lock:
+            if not self._analytics_on:
+                return
+            self._analytics_on = False
+            self.analytics_state = None
+            if self._step is not None:
+                self._rebuild()
+
+    def swap_analytics_epoch(self) -> int:
+        """Flip the A/B epoch: zero the section about to be written,
+        then name it in the control cell.  The fused stage reads the
+        cell dynamically, so the flip is a state swap under the engine
+        lock — never a re-jit, never a serving pause.  Returns the
+        newly quiesced epoch index (what decode should read)."""
+        from ..analytics.stage import CTRL_COL, ctrl_row, epoch_rows
+        with self._lock:
+            if self.analytics_state is None:
+                raise RuntimeError("analytics not enabled")
+            depth = self._analytics_depth
+            lanes = self._analytics_lanes
+            st = self.analytics_state.state
+            er = epoch_rows(depth, lanes)
+            cr = ctrl_row(depth, lanes)
+            cur = int(np.array(st[cr, CTRL_COL]))
+            nxt = 1 - cur
+            st = st.at[nxt * er:(nxt + 1) * er, :].set(jnp.int32(0))
+            st = st.at[cr, CTRL_COL].set(jnp.int32(nxt))
+            if self._replicated_sharding is not None:
+                st = jax.device_put(st, self._replicated_sharding)
+            self.analytics_state = \
+                self.analytics_state._replace(state=st)
+            return cur
+
+    def analytics_snapshot(self) -> Optional[np.ndarray]:
+        """Host copy of the full analytics buffer (None = disabled).
+        The decode layer (analytics/decode.py) reads the quiesced
+        epoch section of this snapshot; a drain cycle is
+        swap_analytics_epoch() followed by one snapshot."""
+        with self._lock:
+            st = self.analytics_state
+        if st is None:
+            return None
+        return np.array(st.state)
+
+    def analytics_report(self) -> Optional[Dict]:
+        """Geometry + epoch report (status surfaces; None =
+        disabled)."""
+        from ..analytics.stage import CTRL_COL, ctrl_row
+        with self._lock:
+            if not self._analytics_on:
+                return None
+            depth = self._analytics_depth
+            lanes = self._analytics_lanes
+            out = {"width": self._analytics_width, "depth": depth,
+                   "lanes": lanes, "stripe": self._analytics_stripe,
+                   "shard": self.shard_index}
+            st = self.analytics_state
+        # a lost device buffer degrades the report, never crashes it
+        # (the sharded merge keeps reporting the healthy shards)
+        out["write-epoch"] = None if st is None else int(np.array(
+            st.state[ctrl_row(depth, lanes), CTRL_COL]))
+        return out
+
     def l7_fast_window(self) -> int:
         """The payload window W callers must encode to (0 = fast
         verdicts disabled; payloads are ignored then).  Read per
@@ -504,6 +611,9 @@ class Datapath:
             self._counters = jax.device_put(self._counters, rep)
         if self.threat_state is not None:
             self.threat_state = jax.device_put(self.threat_state, rep)
+        if self.analytics_state is not None:
+            self.analytics_state = jax.device_put(self.analytics_state,
+                                                  rep)
 
     # -- table loading -------------------------------------------------------
 
@@ -848,6 +958,22 @@ class Datapath:
                 from ..threat.stage import make_threat_state
                 self.threat_state = make_threat_state(
                     self._threat_buckets)
+        # fused traffic analytics: a pure engine-owned state buffer
+        # like the threat state — no table leaves join the pack;
+        # omitted entirely when disabled so the pre-analytics program
+        # stays byte-identical
+        analytics_static = {}
+        if self._analytics_on:
+            analytics_static = dict(
+                with_analytics=1,
+                analytics_depth=self._analytics_depth,
+                analytics_lanes=self._analytics_lanes,
+                analytics_stripe=self._analytics_stripe)
+            if self.analytics_state is None:
+                from ..analytics.stage import make_analytics_state
+                self.analytics_state = make_analytics_state(
+                    self._analytics_width, self._analytics_depth,
+                    self._analytics_lanes)
         self._tables = FullTables(
             datapath=dp, lb=self.lb.compiled.tables,
             pf_masks=jnp.asarray(pf.masks), pf_key_a=jnp.asarray(pf.key_a),
@@ -882,7 +1008,7 @@ class Datapath:
             ct_slots=self.ct.slots, ct_probe=self.ct.max_probe,
             tun_probe=tun_probe)
         self._statics4 = {**v4_static, **flow_kwargs, **l7_static,
-                          **threat_static}
+                          **threat_static, **analytics_static}
 
         # v6 twin: shares the (family-agnostic) policy tensors, runs
         # the 4-word LPMs for prefilter/ipcache and its own CT table.
@@ -905,7 +1031,7 @@ class Datapath:
             ct_slots=self.ct6.slots, ct_probe=self.ct6.max_probe,
             lb6_probe=lb6.max_probe if lb6 is not None else 0)
         self._statics6 = {**v6_static, **flow_kwargs, **l7_static,
-                          **threat_static}
+                          **threat_static, **analytics_static}
 
         # mesh placement: commit every table onto this shard's column
         # submesh so the jitted steps compile as submesh-resident SPMD
@@ -918,6 +1044,9 @@ class Datapath:
             if self.threat_state is not None:
                 self.threat_state = jax.device_put(self.threat_state,
                                                    rep)
+            if self.analytics_state is not None:
+                self.analytics_state = jax.device_put(
+                    self.analytics_state, rep)
 
         # pack the table leaf zoo into the grouped dispatch buffers
         # (the dispatch-floor fix): every jitted step below takes the
@@ -927,13 +1056,15 @@ class Datapath:
 
         def grouped(step_fn, unpack, statics):
             def g(tbufs, ct, counters, batch, now, flows=None,
-                  payload=None, threat=None):
+                  payload=None, threat=None, analytics=None):
                 tables = unpack(tbufs)
-                if flows is None and payload is None and threat is None:
+                if flows is None and payload is None and \
+                        threat is None and analytics is None:
                     return step_fn(tables, ct, counters, batch, now,
                                    **statics)
                 return step_fn(tables, ct, counters, batch, now,
-                               flows, payload, threat, **statics)
+                               flows, payload, threat, analytics,
+                               **statics)
             return jax.jit(g, donate_argnums=(1, 2))
 
         from ..parallel import packing
@@ -1009,9 +1140,11 @@ class Datapath:
                 np.zeros((1, self._l7_fast.window), np.int32),)
             threat = () if self._threat is None else \
                 (self.threat_state,)
+            analytics = () if not self._analytics_on else \
+                (self.analytics_state,)
             packed_args = (self._tbufs4, self.ct.state, self._counters,
                            np.zeros((10, 1), np.int32), 0) + flows \
-                + payload + threat
+                + payload + threat + analytics
             n_packed = len(tree_leaves(packed_args))
             # v6 keeps the per-field packet batch (10 leaves) but the
             # same grouped tables/state
@@ -1019,13 +1152,15 @@ class Datapath:
                                      self._counters))) + 10 + 1
                     + len(tree_leaves(flows))
                     + len(tree_leaves(payload))
-                    + len(tree_leaves(threat)))
+                    + len(tree_leaves(threat))
+                    + len(tree_leaves(analytics)))
             # the legacy-pytree equivalent: raw table leaves + per-leaf
             # CT state + per-leaf counters + batch + timestamp
             n_legacy = (len(tree_leaves(self._tables)) + 8 + 2 + 1 + 1
                         + len(tree_leaves(flows))
                         + len(tree_leaves(payload))
-                        + len(tree_leaves(threat)))
+                        + len(tree_leaves(threat))
+                        + len(tree_leaves(analytics)))
             return {"packed-step": n_packed,
                     "v6-step": n_v6,
                     "legacy-step": n_legacy,
@@ -1042,6 +1177,9 @@ class Datapath:
         if self._l7_fast is not None:
             pl = jnp.asarray(
                 self._payload_in(None, int(packed.shape[1])))
+        if self._analytics_on:
+            return args + (None, pl, self.threat_state,
+                           self.analytics_state)
         if self._threat is not None:
             return args + (None, pl, self.threat_state)
         if pl is not None:
@@ -1091,10 +1229,15 @@ class Datapath:
         return cached
 
     def _dispatch_locked(self, step, tbufs, ct_state, batch, ts,
-                         flows_in, payload, threat=None):
-        """One jitted-step call with the optional flows/payload/threat
-        lanes threaded positionally (lock held).  Call shapes stay
-        stable per configuration, so the jit cache sees one entry."""
+                         flows_in, payload, threat=None,
+                         analytics=None):
+        """One jitted-step call with the optional flows/payload/threat/
+        analytics lanes threaded positionally (lock held).  Call shapes
+        stay stable per configuration, so the jit cache sees one
+        entry."""
+        if analytics is not None:
+            return step(tbufs, ct_state, self._counters, batch, ts,
+                        flows_in, payload, threat, analytics)
         if threat is not None:
             return step(tbufs, ct_state, self._counters, batch, ts,
                         flows_in, payload, threat)
@@ -1137,7 +1280,8 @@ class Datapath:
             outs = self._dispatch_locked(step, self._tbufs4,
                                          self.ct.state, pkt, ts,
                                          flows_in, pl,
-                                         self.threat_state)
+                                         self.threat_state,
+                                         self.analytics_state)
             verdict, event, identity, nat = outs[:4]
             self.ct.state, self._counters = outs[4], outs[5]
             tail = 6
@@ -1148,6 +1292,9 @@ class Datapath:
                 self.threat_state = outs[tail]
                 self.last_threat = outs[tail + 1]
                 tail += 2
+            if self._analytics_on:
+                self.analytics_state = outs[tail]
+                tail += 1
             if self.provenance_enabled:
                 self.last_provenance = Provenance(outs[tail],
                                                   outs[tail + 1])
@@ -1183,7 +1330,8 @@ class Datapath:
             outs = self._dispatch_locked(step, self._tbufs6,
                                          self.ct6.state, pkt, ts,
                                          flows_in, pl,
-                                         self.threat_state)
+                                         self.threat_state,
+                                         self.analytics_state)
             verdict, event, identity, nat = outs[:4]
             self.ct6.state, self._counters = outs[4], outs[5]
             tail = 6
@@ -1194,6 +1342,9 @@ class Datapath:
                 self.threat_state = outs[tail]
                 self.last_threat = outs[tail + 1]
                 tail += 2
+            if self._analytics_on:
+                self.analytics_state = outs[tail]
+                tail += 1
             if self.provenance_enabled:
                 self.last_provenance = Provenance(outs[tail],
                                                   outs[tail + 1])
@@ -1242,7 +1393,8 @@ class Datapath:
             outs = self._dispatch_locked(step, self._tbufs4,
                                          self.ct.state, packed, ts,
                                          flows_in, pl,
-                                         self.threat_state)
+                                         self.threat_state,
+                                         self.analytics_state)
             verdict, event, identity, nat = outs[:4]
             self.ct.state, self._counters = outs[4], outs[5]
             tail = 6
@@ -1253,6 +1405,9 @@ class Datapath:
                 self.threat_state = outs[tail]
                 self.last_threat = outs[tail + 1]
                 tail += 2
+            if self._analytics_on:
+                self.analytics_state = outs[tail]
+                tail += 1
             if self.provenance_enabled:
                 self.last_provenance = Provenance(outs[tail],
                                                   outs[tail + 1])

@@ -288,7 +288,7 @@ def host_fail_static_step(soa, n: int, *, established, identity_of,
 def full_datapath_step_packed(tables: FullTables, ct,
                               counters: Counters, packed, now,
                               flows=None, payload=None, threat=None,
-                              **statics):
+                              analytics=None, **statics):
     """full_datapath_step over ONE [10, B] int32 field matrix.
 
     The latency-tier fix for small-batch dispatch overhead: ten
@@ -303,7 +303,8 @@ def full_datapath_step_packed(tables: FullTables, ct,
     pkt = FullPacketBatch(**{f: packed[i]
                              for i, f in enumerate(PACKED_FIELDS)})
     return full_datapath_step(tables, ct, counters, pkt, now,
-                              flows, payload, threat, **statics)
+                              flows, payload, threat, analytics,
+                              **statics)
 
 
 def _l7_fast_stage(tables, payload, pol_verdict, pol_slot, *,
@@ -361,7 +362,8 @@ def _l7_fast_stage(tables, payload, pol_verdict, pol_slot, *,
 
 def full_datapath_step(tables: FullTables, ct, counters: Counters,
                        pkt: FullPacketBatch, now: jnp.ndarray,
-                       flows=None, payload=None, threat=None, *,
+                       flows=None, payload=None, threat=None,
+                       analytics=None, *,
                        policy_probe: int, lpm_probe: int, pf_probe: int,
                        lb_probe: int, ct_slots: int, ct_probe: int,
                        tun_probe: int = 0, flow_slots: int = 0,
@@ -371,7 +373,11 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
                        with_l7_fast: int = 0, l7_k: int = 1,
                        l7_c1: int = 2, with_threat: int = 0,
                        threat_window_s: int = 8,
-                       threat_stripe: int = 4):
+                       threat_stripe: int = 4,
+                       with_analytics: int = 0,
+                       analytics_depth: int = 2,
+                       analytics_lanes: int = 4,
+                       analytics_stripe: int = 16):
     """The batched equivalent of the reference's per-packet egress path
     (bpf_lxc.c:432 handle_ipv4_from_lxc): XDP prefilter drop, service
     DNAT (lb4_local), conntrack lookup, ipcache identity resolve, policy
@@ -408,6 +414,15 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
     rate-limit, and NEVER overrides an existing drop.  Appends
     (threat', threat_out [B]) outputs.  0 keeps the compiled program
     byte-identical to the pre-threat step.
+
+    ``with_analytics`` (static) fuses the device-resident traffic-
+    analytics stage (analytics/stage.py): the batch's FINAL verdicts
+    fold into ``analytics`` (the shard-local AnalyticsState buffer) —
+    count-min heavy-hitter sketches, candidate key tables, and
+    distinct-flow cardinality registers — and the updated state is
+    appended as one extra output.  0 keeps the compiled program
+    byte-identical to the pre-analytics step (the analytics arg is
+    never passed then).
     """
     from .conntrack import CT_NEW, CTBatch, ct_step
     from .events import (DROP_FRAG_NOSUPPORT, DROP_POLICY, DROP_POLICY_L7,
@@ -550,6 +565,20 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
         event = jnp.where(verdict == jnp.int32(VERDICT_DROP_THREAT),
                           jnp.int32(DROP_THREAT), event)
 
+    # 8.5 Fused traffic analytics (analytics/stage.py): fold the
+    # batch's FINAL verdicts into the device-resident heavy-hitter
+    # sketches / candidate key tables / cardinality registers — one
+    # scatter-add per sketch plus one combined max-scatter.  Runs
+    # post-threat so the drops metric attributes every drop arm.
+    if with_analytics:
+        from ..analytics.stage import analytics_stage
+        analytics = analytics_stage(
+            analytics, identity=identity, dport=dport, proto=pkt.proto,
+            sport=pkt.sport, length=pkt.length, verdict=verdict,
+            saddr_key=pkt.saddr, daddr_key=daddr, now=now,
+            depth=analytics_depth, lanes=analytics_lanes,
+            stripe=analytics_stripe)
+
     # 9. Overlay encap (encap.h encap_and_redirect): allowed egress
     # packets whose (DNAT'd) destination falls in a peer node's pod
     # CIDR leave encapsulated to that node's tunnel endpoint, carrying
@@ -599,6 +628,11 @@ def full_datapath_step(tables: FullTables, ct, counters: Counters,
         # and the per-packet score|band|fired lane (engine keeps the
         # last batch's lane for the observability consumers)
         out = out + (threat, threat_out)
+    if with_analytics:
+        # 10.7 Analytics output: the updated shard-local buffer (the
+        # host never reads per-batch lanes — decode.py queries the
+        # quiesced epoch of this state directly)
+        out = out + (analytics,)
     if with_provenance:
         # 11. Provenance finalization: mirror the final-verdict
         # precedence (step 7) — prefilter beats everything, CT
@@ -762,7 +796,8 @@ def fold6(words: jnp.ndarray) -> jnp.ndarray:
 
 def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                         pkt: FullPacketBatch6, now: jnp.ndarray,
-                        flows=None, payload=None, threat=None, *,
+                        flows=None, payload=None, threat=None,
+                        analytics=None, *,
                         policy_probe: int, lpm6_probe: int,
                         pf6_probe: int, ct_slots: int, ct_probe: int,
                         lb6_probe: int = 0, flow_slots: int = 0,
@@ -772,7 +807,11 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
                         with_l7_fast: int = 0, l7_k: int = 1,
                         l7_c1: int = 2, with_threat: int = 0,
                         threat_window_s: int = 8,
-                        threat_stripe: int = 4):
+                        threat_stripe: int = 4,
+                        with_analytics: int = 0,
+                        analytics_depth: int = 2,
+                        analytics_lanes: int = 4,
+                        analytics_stripe: int = 16):
     """The v6 twin of full_datapath_step (bpf_lxc.c:745 ipv6_policy):
     prefilter drop, service DNAT (lb6_local), conntrack, ipcache
     identity, policy verdict for CT_NEW flows, CT create gated on the
@@ -955,6 +994,18 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
     if with_threat:
         event = jnp.where(verdict == jnp.int32(VERDICT_DROP_THREAT),
                           jnp.int32(DROP_THREAT), event)
+
+    # 7.5 Fused traffic analytics (same stage as the v4 family; the
+    # address words enter the flow hash and dst-prefix key as their CT
+    # folds — deterministic, shared with the oracle).
+    if with_analytics:
+        from ..analytics.stage import analytics_stage
+        analytics = analytics_stage(
+            analytics, identity=identity, dport=dport, proto=pkt.proto,
+            sport=pkt.sport, length=pkt.length, verdict=verdict,
+            saddr_key=ctb.saddr, daddr_key=ctb.daddr, now=now,
+            depth=analytics_depth, lanes=analytics_lanes,
+            stripe=analytics_stripe)
     nat = NAT6Result(daddr=daddr, dport=dport, saddr=nat_saddr,
                      sport=nat_sport, rev_nat=ct_rev_nat)
     out = (verdict, event, identity, nat, ct, counters)
@@ -974,6 +1025,8 @@ def full_datapath_step6(tables: FullTables6, ct, counters: Counters,
         out = out + (flows,)
     if with_threat:
         out = out + (threat, threat_out)
+    if with_analytics:
+        out = out + (analytics,)
     if with_provenance:
         # Provenance finalization, mirroring the v6 verdict
         # precedence: prefilter, then the local ICMPv6 responder

@@ -44,7 +44,9 @@ FLIGHT_RECORDER_EVENTS = registry.counter(
 FLIGHT_RECORDER_DROPPED = registry.counter(
     "flight_recorder_dropped_total",
     "Flight-recorder events evicted from the bounded ring before "
-    "being read through a cursor")
+    "being read through a cursor, by evicted event type (a noisy "
+    "emitter shows up as ITS type overrunning the ring, not as an "
+    "anonymous aggregate)")
 
 # ---------------------------------------------------------------------------
 # Event type registry.  Each type is one degraded-condition transition;
@@ -66,6 +68,8 @@ EVENT_CONTROLLER_FAILING = "controller-failing"
 EVENT_MAP_PRESSURE = "map-pressure-warning"
 EVENT_THREAT_MODE = "threat-mode"
 EVENT_THREAT_MODEL = "threat-model-push"
+EVENT_TRAFFIC_HEAVY_HITTER = "traffic-heavy-hitter"
+EVENT_TRAFFIC_SCAN_SUSPECT = "traffic-scan-suspect"
 
 EVENT_TYPES: Dict[str, str] = {
     EVENT_DATAPLANE_TRIP:
@@ -111,6 +115,15 @@ EVENT_TYPES: Dict[str, str] = {
     EVENT_THREAT_MODEL:
         "a threat-model weight push hot-swapped through the "
         "delta-apply path (attrs: generation, repacked)",
+    EVENT_TRAFFIC_HEAVY_HITTER:
+        "an identity crossed the heavy-hitter byte-share threshold in "
+        "a decoded analytics epoch (attrs: identity, share, bytes) — "
+        "transition-edged per identity, so the timeline orders the "
+        "hitter next to the overload/threat events it explains",
+    EVENT_TRAFFIC_SCAN_SUSPECT:
+        "the analytics scan view flagged an identity probing many "
+        "distinct destination ports in one epoch (attrs: identity, "
+        "ports, packets)",
 }
 
 # ---------------------------------------------------------------------------
@@ -160,6 +173,14 @@ DEGRADED_SIGNALS: Dict[str, Dict[str, tuple]] = {
                     "cilium_tpu_threat_score",
                     "cilium_tpu_threat_model_generation"),
     },
+    "analytics": {
+        "events": (EVENT_TRAFFIC_HEAVY_HITTER,
+                   EVENT_TRAFFIC_SCAN_SUSPECT),
+        "metrics": ("cilium_tpu_analytics_top_bytes",
+                    "cilium_tpu_analytics_drains_total",
+                    "cilium_tpu_analytics_queries_total",
+                    "cilium_tpu_analytics_scan_suspects"),
+    },
 }
 
 
@@ -207,6 +228,7 @@ class FlightRecorder:
         self._ring: List[FlightEvent] = []
         self._next_seq = 1
         self.evicted = 0
+        self.evicted_by_type: Dict[str, int] = {}
 
     def record(self, event_type: str, detail: str = "",
                shard: Optional[int] = None,
@@ -236,9 +258,16 @@ class FlightRecorder:
             self._ring.append(ev)
             if len(self._ring) > self.capacity:
                 drop = len(self._ring) - self.capacity
+                # account the evicted slice by type BEFORE truncating:
+                # the dropped series answers "whose events did the
+                # overrun cost us", not just "how many"
+                for dropped in self._ring[:drop]:
+                    self.evicted_by_type[dropped.type] = \
+                        self.evicted_by_type.get(dropped.type, 0) + 1
+                    FLIGHT_RECORDER_DROPPED.inc(
+                        labels={"type": dropped.type})
                 self._ring = self._ring[drop:]
                 self.evicted += drop
-                FLIGHT_RECORDER_DROPPED.inc(drop)
         FLIGHT_RECORDER_EVENTS.inc(labels={"type": event_type})
         return ev
 
@@ -274,7 +303,8 @@ class FlightRecorder:
                 by_type[e.type] = by_type.get(e.type, 0) + 1
             return {"capacity": self.capacity, "ringed": ringed,
                     "seq": self._next_seq - 1, "evicted": self.evicted,
-                    "by-type": by_type}
+                    "by-type": by_type,
+                    "evicted-by-type": dict(self.evicted_by_type)}
 
     def reset(self) -> None:
         """Drop all buffered events (test isolation; cursors keep

@@ -62,6 +62,11 @@ _THREAT_MODEL_LEAVES = frozenset(
 # the engine-owned mutable threat buffer (threat/stage.ThreatState):
 # not manifest-built, same lint-enforced group namespace as ct-state
 THREAT_STATE_GROUP = "threat-state"
+# the engine-owned traffic-analytics buffer (analytics/stage.
+# AnalyticsState): sketches + key tables + cardinality registers as
+# one [R, W] int32 leaf — not manifest-built, same lint-enforced
+# group namespace as ct-state/threat-state
+ANALYTICS_STATE_GROUP = "analytics-state"
 
 
 class LeafSlot(NamedTuple):

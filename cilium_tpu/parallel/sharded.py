@@ -305,6 +305,7 @@ class ShardedDatapath:
             self.shards.append(eng)
         self._serving_lane: Optional[ShardedServingLane] = None
         self._table_mgr: Optional[ShardedTableManager] = None
+        self._analytics_breakers: List = []
         self._lock = threading.Lock()
 
     # ------------------------------------------------------- geometry
@@ -584,6 +585,120 @@ class ShardedDatapath:
         if not outs:
             return None
         return np.concatenate([np.array(o) for o in outs])
+
+    # --------------------------------------- device traffic analytics
+
+    def enable_analytics(self, width: int = 1 << 12, depth: int = 2,
+                         lanes: int = 4, stripe: int = 16) -> None:
+        """Fan the fused traffic-analytics stage to every shard: each
+        shard folds its own traffic into its OWN AnalyticsState buffer
+        (shard-local, the threat-state precedent).  Mesh-wide answers
+        merge the per-shard quiesced sections host-side — sketches add
+        elementwise, key tables and cardinality registers max, both
+        order-free — so a top-K query never pauses serving."""
+        from ..utils.resilience import CircuitBreaker
+        with self._lock:
+            self._analytics_breakers = [
+                CircuitBreaker(f"analytics-drain:shard{k}",
+                               failure_threshold=2, reset_timeout=0.5,
+                               max_reset=10.0)
+                for k in range(self.n_shards)]
+        for sh in self.shards:
+            sh.enable_analytics(width=width, depth=depth, lanes=lanes,
+                                stripe=stripe)
+
+    def disable_analytics(self) -> None:
+        for sh in self.shards:
+            sh.disable_analytics()
+
+    def swap_analytics_epoch(self) -> Dict[int, int]:
+        """Flip every shard's A/B epoch (each swap is a state write
+        under that engine's own lock — no global pause).  Returns
+        {shard: newly quiesced epoch}."""
+        return {k: sh.swap_analytics_epoch()
+                for k, sh in enumerate(self.shards)}
+
+    def analytics_sections(self, swap: bool = True) -> Dict:
+        """Per-shard quiesced epoch sections behind per-shard
+        breakers: an unreadable shard contributes a flagged error and
+        the mesh answer degrades to a ``partial`` (fail-open — the
+        federated Hubble drain precedent), never a hang.  ``swap``
+        flips each readable shard's epoch first, so the sections
+        cover traffic since the previous drain cycle."""
+        from ..analytics import decode as adec
+        eng0 = self.shards[0]
+        depth = eng0._analytics_depth
+        lanes = eng0._analytics_lanes
+        with self._lock:
+            breakers = list(self._analytics_breakers)
+        sections: List = []
+        shards: Dict[str, Dict] = {}
+        for k, sh in enumerate(self.shards):
+            breaker = breakers[k] if k < len(breakers) else None
+            if breaker is not None and not breaker.allow():
+                shards[str(k)] = {"status": "breaker-open"}
+                continue
+            try:
+                if swap:
+                    epoch = sh.swap_analytics_epoch()
+                    snap = sh.analytics_snapshot()
+                    section = adec.epoch_section(snap, epoch, depth,
+                                                 lanes)
+                else:
+                    snap = sh.analytics_snapshot()
+                    section = adec.quiesced_section(snap, depth,
+                                                    lanes)
+            except Exception as e:  # noqa: BLE001 — per-shard
+                if breaker is not None:
+                    breaker.record_failure()   # fail-open, not a hang
+                shards[str(k)] = {"status": "error", "error": repr(e)}
+                continue
+            if breaker is not None:
+                breaker.record_success()
+            sections.append(section)
+            shards[str(k)] = {"status": "ok"}
+        partial = any(s["status"] != "ok" for s in shards.values())
+        return {"sections": sections, "shards": shards,
+                "partial": partial, "depth": depth, "lanes": lanes}
+
+    def analytics_query(self, view: str = "talkers", k: int = 10,
+                        metric: str = "bytes",
+                        swap: bool = True) -> Dict:
+        """ONE mesh-wide top-K answer: merge every readable shard's
+        quiesced section, decode the merged section once.  A degraded
+        shard shows up as ``partial`` + its flagged status — the
+        remaining shards' answer still serves (fail-open)."""
+        from ..analytics import decode as adec
+        secs = self.analytics_sections(swap=swap)
+        if not secs["sections"]:
+            return {"view": view, "entries": [], "partial": True,
+                    "shards": secs["shards"]}
+        merged = adec.merge_sections(secs["sections"], secs["depth"],
+                                     secs["lanes"])
+        entries = adec.decode_view(merged, view, secs["depth"],
+                                   secs["lanes"], k=k, metric=metric)
+        return {"view": view, "entries": entries,
+                "partial": secs["partial"], "shards": secs["shards"]}
+
+    def analytics_snapshot(self):
+        """Shard 0's raw buffer (single-engine API parity; mesh-wide
+        consumers use analytics_sections/analytics_query)."""
+        return self.shards[0].analytics_snapshot()
+
+    def analytics_report(self):
+        """Merged report: shard 0's geometry + per-shard epochs."""
+        base = self.shards[0].analytics_report()
+        if base is None:
+            return None
+        base["shards"] = {str(k): sh.analytics_report()
+                          for k, sh in enumerate(self.shards)}
+        base.pop("shard", None)
+        with self._lock:
+            breakers = list(self._analytics_breakers)
+        if breakers:
+            base["open-breakers"] = sum(
+                1 for b in breakers if b.state != "closed")
+        return base
 
     # -------------------------------------------------------- serving
 

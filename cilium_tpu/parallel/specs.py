@@ -140,6 +140,14 @@ THREAT_STATE_SPECS: Dict[str, P] = {
     "state": SHARD_LOCAL,
 }
 
+# the traffic-analytics buffer (analytics/stage.AnalyticsState):
+# sketches, key tables and cardinality registers are shard-local —
+# each shard folds its own traffic, and the mesh-wide answer merges
+# shards host-side (add sketches / max registers, decode.py)
+ANALYTICS_STATE_SPECS: Dict[str, P] = {
+    "state": SHARD_LOCAL,
+}
+
 # ---------------------------------------------------------------------------
 # Packed dispatch-buffer groups (parallel/packing.py): the grouped flat
 # buffers the jitted steps actually take.  Each group's spec is the
@@ -172,6 +180,9 @@ PACKED_GROUP_SPECS: Dict[str, P] = {
     "threat-state": SHARD_LOCAL,   # [6, T+1] token-bucket/window
     #                                buffer (NOT donated, the
     #                                flow-state precedent)
+    "analytics-state": SHARD_LOCAL,  # [R, W] sketch/register buffer
+    #                                (NOT donated, the flow-state
+    #                                precedent; analytics/stage.py)
 }
 
 
@@ -181,6 +192,7 @@ def _table_classes():
     from ..datapath.pipeline import (DatapathTables, FullTables,
                                      FullTables6, LPM6Tables)
     from ..datapath.verdict import Counters
+    from ..analytics.stage import AnalyticsState
     from ..hubble.aggregation import FlowState
     from ..threat.stage import ThreatState
     return {
@@ -194,6 +206,7 @@ def _table_classes():
         FlowState: FLOW_STATE_SPECS,
         Counters: COUNTERS_SPECS,
         ThreatState: THREAT_STATE_SPECS,
+        AnalyticsState: ANALYTICS_STATE_SPECS,
     }
 
 

@@ -409,3 +409,26 @@ HUBBLE_FEDERATION_SHARDS = registry.gauge(
     "hubble_federation_shards",
     "Federated observer shard planes by state (available = store "
     "serving and drain breaker closed)")
+
+# Device-resident traffic-analytics series (analytics/ + the fused
+# sketch stage in datapath/pipeline.py): heavy-hitter byte shares
+# decoded from the quiesced sketch epoch, the drain/query accounting
+# of the merged mesh-wide answer, and the scan view's suspect count.
+ANALYTICS_TOP_BYTES = registry.gauge(
+    "analytics_top_bytes",
+    "Bytes attributed to a top-K heavy-hitter identity in the last "
+    "decoded analytics epoch, by identity (cardinality capped at the "
+    "drain controller's K — evicted identities drop from the series)")
+ANALYTICS_DRAINS = registry.counter(
+    "analytics_drains_total",
+    "Analytics epoch drains (swap + decode of the quiesced sketch "
+    "sections), by result (ok = every shard readable, partial = at "
+    "least one shard breaker-open or unreadable)")
+ANALYTICS_QUERIES = registry.counter(
+    "analytics_queries_total",
+    "Merged mesh-wide analytics top-K queries served, by view "
+    "(talkers / scanners / spreaders) and result (ok / partial)")
+ANALYTICS_SCAN_SUSPECTS = registry.gauge(
+    "analytics_scan_suspects",
+    "Identities the analytics scan view flagged above the "
+    "distinct-destination-port threshold in the last decoded epoch")

@@ -334,6 +334,23 @@ class DaemonConfig:
     threat_redirect_port: int = 0      # the redirect arm's proxy port
     threat_rate_per_s: float = 256.0   # token-bucket refill rate
     threat_burst: int = 1024           # token-bucket capacity
+    # device-resident traffic analytics (cilium_tpu/analytics/): fuse
+    # the count-min sketch + cardinality-register stage into both
+    # family pipelines.  Disabled = the jitted programs are
+    # byte-identical pre-analytics (the with_threat precedent); the
+    # drain controller swaps the A/B epoch and decodes the quiesced
+    # section into capped top-K gauges + anomaly events
+    enable_analytics: bool = False
+    analytics_width: int = 1 << 12     # sketch columns (power of two)
+    analytics_depth: int = 2           # salted hash rows per sketch
+    analytics_lanes: int = 4           # cardinality hash-max lanes
+    analytics_stripe: int = 16         # 1-in-N update stripe (the
+    #   fused-overhead budget: scatter cost scales with the sampled
+    #   fraction; 16 holds the analytics-overhead bench gate)
+    analytics_drain_interval_s: float = 1.0  # 0 disables the controller
+    analytics_top_k: int = 8           # exported heavy-hitter gauge cap
+    analytics_scan_ports: int = 16     # scan-suspect distinct-dport bar
+    analytics_hh_share: float = 0.25   # heavy-hitter byte-share bar
     kvstore: str = "memory"
     kvstore_opts: Dict[str, str] = field(default_factory=dict)
     # runtime-mutable option map shared by new endpoints

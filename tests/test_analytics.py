@@ -1,0 +1,591 @@
+"""Device-resident traffic analytics (cilium_tpu/analytics/): the
+sketch-based heavy-hitter / scan / cardinality plane fused into the
+verdict pipelines.
+
+- **Fused parity** — the device AnalyticsState buffer replays
+  bit-exactly against the numpy oracle over multiple batches and
+  epoch swaps, v4 AND v6, with flows + threat + provenance fused
+  (the full-pipeline shape).
+- **Disabled path** — enable->disable lowers the byte-identical
+  pre-analytics program (lowered-HLO-asserted).
+- **Epoch protocol** — a swap is one control-cell write: the
+  quiesced section is immutable under continued serving load, new
+  batches land only in the write section.
+- **Decode views** — talkers / scanners / spreaders / prefixes name
+  planted offenders; count-min estimates never under-count.
+- **Mesh merge** — sketch counts add, key tables and registers max,
+  order-free; a degraded shard degrades the answer to a flagged
+  ``partial`` (fail-open), its breaker opens, serving never pauses.
+- **Live-daemon journey** — drain controller -> capped top-K gauge
+  export -> edge-triggered heavy-hitter / scan-suspect flight-
+  recorder events -> REST + CLI top views.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.analytics import decode as adec
+from cilium_tpu.analytics.oracle import (oracle_analytics_step,
+                                         oracle_swap_epoch)
+from cilium_tpu.analytics.stage import (KS_IDENTITY, MET_BYTES,
+                                        N_KEYSPACES, N_METRICS,
+                                        epoch_rows)
+from cilium_tpu.datapath.engine import Datapath, make_full_batch6
+from cilium_tpu.datapath.pipeline import PACKED_FIELDS
+from cilium_tpu.datapath.verdict import VERDICT_DROP
+from cilium_tpu.policy.mapstate import (EGRESS, INGRESS, PolicyKey,
+                                        PolicyMapState,
+                                        PolicyMapStateEntry)
+from cilium_tpu.threat import ThreatConfig, default_model
+
+HTTP_ID, DNS_ID = 777, 888
+WORLD = 2
+EP_IDENTITY = 1234
+WIDTH = 1 << 10
+DEPTH, LANES, STRIPE = 2, 4, 4
+
+
+def _policy():
+    st = PolicyMapState()
+    st[PolicyKey(identity=HTTP_ID, dest_port=80, nexthdr=6,
+                 direction=INGRESS)] = PolicyMapStateEntry()
+    st[PolicyKey(identity=DNS_ID, dest_port=53, nexthdr=17,
+                 direction=EGRESS)] = PolicyMapStateEntry()
+    return st
+
+
+def _engine(analytics=True, flows=True, provenance=True, threat=True,
+            stripe=STRIPE, ct_slots=1 << 10):
+    dp = Datapath(ct_slots=ct_slots)
+    dp.telemetry_enabled = False
+    if provenance:
+        dp.enable_provenance()
+    if flows:
+        dp.enable_flow_aggregation(slots=1 << 8, claim_every=1)
+    if threat:
+        # shadow mode: the threat stage is fused (scores every row)
+        # but never flips a verdict, so the host verdict twin below
+        # stays the plain policy+CT oracle
+        dp.enable_threat(default_model(ThreatConfig()), buckets=64,
+                         window_s=8)
+    if analytics:
+        dp.enable_analytics(width=WIDTH, depth=DEPTH, lanes=LANES,
+                            stripe=stripe)
+    dp.load_policy([_policy()], revision=1, ipcache_prefixes={
+        "10.0.0.0/8": HTTP_ID, "20.0.0.0/8": DNS_ID})
+    dp.set_endpoint_identity(0, EP_IDENTITY)
+    return dp
+
+
+def _traffic(rng, n, sport0):
+    """Mixed batch: allowed HTTP ingress (10/8 -> 777), allowed DNS
+    egress (daddr 20/8 -> 888), and WORLD-sourced denied rows."""
+    kind = rng.integers(0, 3, n)           # 0 http, 1 dns, 2 denied
+    is_http = kind == 0
+    is_dns = kind == 1
+    saddr = np.where(is_http, (10 << 24) | 5, (50 << 24) | 9) \
+        .astype(np.uint32)
+    daddr = np.where(is_dns, (20 << 24) | 9, (10 << 24) | 8) \
+        .astype(np.uint32)
+    recs = {
+        "endpoint": np.zeros(n, np.int32),
+        "saddr": saddr.view(np.int32),
+        "daddr": daddr.view(np.int32),
+        "sport": (sport0 + np.arange(n)).astype(np.int32),
+        "dport": np.where(is_http, 80,
+                          np.where(is_dns, 53,
+                                   rng.integers(1, 65536, n))
+                          ).astype(np.int32),
+        "proto": np.where(is_dns, 17, 6).astype(np.int32),
+        "direction": np.where(is_http, 0, 1).astype(np.int32),
+        "tcp_flags": np.where(rng.random(n) < 0.5, 0x02, 0x10)
+        .astype(np.int32),
+        "length": rng.integers(60, 1500, n).astype(np.int32),
+        "is_fragment": np.zeros(n, np.int32),
+    }
+    return _stage_of(recs), recs
+
+
+def _stage_of(recs):
+    n = recs["endpoint"].shape[0]
+    stage = np.empty((len(PACKED_FIELDS), n), np.int32)
+    for i, f in enumerate(PACKED_FIELDS):
+        stage[i] = recs[f]
+    return stage
+
+
+def _identities(recs):
+    """Host ipcache twin: resolved peer identity per row."""
+    sa = recs["saddr"].view(np.uint32)
+    da = recs["daddr"].view(np.uint32)
+    peer = np.where(recs["direction"] == 0, sa, da)
+    ident = np.full(peer.shape[0], WORLD, np.int32)
+    ident[(peer >> 24) == 10] = HTTP_ID
+    ident[(peer >> 24) == 20] = DNS_ID
+    return ident
+
+
+def _policy_verdict(ident, recs):
+    """Host policy twin of the two installed rules."""
+    ok = ((ident == HTTP_ID) & (recs["dport"] == 80) &
+          (recs["proto"] == 6) & (recs["direction"] == 0)) | \
+         ((ident == DNS_ID) & (recs["dport"] == 53) &
+          (recs["proto"] == 17) & (recs["direction"] == 1))
+    return np.where(ok, 0, VERDICT_DROP).astype(np.int32)
+
+
+def _established_from_ct(dp, recs):
+    """Pre-batch established view from the live CT dump (forward
+    tuples only; test traffic never sends replies)."""
+    live = {(e["saddr"], e["daddr"], e["sport"], e["dport"],
+             e["proto"]) for e in dp.map_dump("ct", max_entries=1 << 14)}
+    sa = recs["saddr"].view(np.uint32)
+    da = recs["daddr"].view(np.uint32)
+    return np.array([
+        (int(sa[i]), int(da[i]), int(recs["sport"][i]),
+         int(recs["dport"][i]), int(recs["proto"][i])) in live
+        for i in range(sa.shape[0])], bool)
+
+
+def _blank(width=WIDTH, depth=DEPTH, lanes=LANES):
+    """Fresh host mirror of the [R, W] AnalyticsState buffer."""
+    return np.zeros((2 * epoch_rows(depth, lanes) + 1, width),
+                    np.int32)
+
+
+# ------------------------------------------------------ fused parity
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_fused_parity_vs_oracle_v4(seed):
+    """The device analytics buffer (sketches, key tables, cardinality
+    registers AND the epoch control cell) replays bit-exactly against
+    the numpy oracle over multiple batches, shifting stripe phases,
+    and a mid-test epoch swap — flows + threat + provenance fused."""
+    rng = np.random.default_rng(seed)
+    dp = _engine()
+    mirror = _blank()
+    now = 1000 + seed          # seeds land on different stripe phases
+    sport0 = 20000
+    for batch in range(4):
+        if batch == 2:
+            # mid-test epoch swap: device and oracle flip in lockstep
+            assert dp.swap_analytics_epoch() == \
+                oracle_swap_epoch(mirror, DEPTH, LANES)
+        n = 96
+        stage, recs = _traffic(rng, n, sport0)
+        sport0 += n
+        ident = _identities(recs)
+        verdict = np.where(_established_from_ct(dp, recs), 0,
+                           _policy_verdict(ident, recs))
+        v, e, got_ident, _nat = dp.process_packed(stage, now=now)
+        # the oracle's inputs are the HOST twins — assert the device
+        # agrees before folding them, so parity is end-to-end
+        np.testing.assert_array_equal(np.asarray(got_ident), ident)
+        np.testing.assert_array_equal(np.asarray(v), verdict)
+        oracle_analytics_step(
+            mirror, identity=ident, dport=recs["dport"],
+            proto=recs["proto"], sport=recs["sport"],
+            length=recs["length"], verdict=verdict,
+            saddr_key=recs["saddr"], daddr_key=recs["daddr"],
+            now=now, depth=DEPTH, lanes=LANES, stripe=STRIPE)
+        np.testing.assert_array_equal(
+            np.asarray(dp.analytics_state.state), mirror,
+            err_msg=f"analytics state diverged (batch {batch})")
+        now += 3
+
+
+def test_fused_parity_vs_oracle_v6():
+    """The v6 twin folds through the shared stage; the address words
+    enter the flow hash and dst-prefix key as their CT folds."""
+    from cilium_tpu.datapath.pipeline import fold6
+    dp = Datapath(ct_slots=1 << 8)
+    dp.telemetry_enabled = False
+    dp.enable_provenance()
+    dp.enable_threat(default_model(ThreatConfig()), buckets=64,
+                     window_s=8)
+    dp.enable_analytics(width=WIDTH, depth=DEPTH, lanes=LANES,
+                        stripe=STRIPE)
+    dp.load_policy([_policy()], revision=1)
+    dp.load_ipcache6({"fd00::/16": HTTP_ID})
+    dp.set_endpoint_identity(0, EP_IDENTITY)
+    n = 32
+    dports = [80 if i % 2 == 0 else 81 for i in range(n)]
+    pkt = make_full_batch6(
+        endpoint=[0] * n, saddr=["fd00::5"] * n,
+        daddr=["fd00::9"] * n, sport=[30000 + i for i in range(n)],
+        dport=dports, proto=[6] * n, direction=[0] * n)
+    mirror = _blank()
+    ident = np.full(n, HTTP_ID, np.int32)
+    verdict = np.where(np.array(dports) == 80, 0,
+                       VERDICT_DROP).astype(np.int32)
+    v, e, got_ident, _nat = dp.process6(pkt, now=501)
+    np.testing.assert_array_equal(np.asarray(got_ident), ident)
+    np.testing.assert_array_equal(np.asarray(v), verdict)
+    oracle_analytics_step(
+        mirror, identity=ident, dport=np.asarray(pkt.dport),
+        proto=np.asarray(pkt.proto), sport=np.asarray(pkt.sport),
+        length=np.asarray(pkt.length), verdict=verdict,
+        saddr_key=np.asarray(fold6(pkt.saddr)),
+        daddr_key=np.asarray(fold6(pkt.daddr)),
+        now=501, depth=DEPTH, lanes=LANES, stripe=STRIPE)
+    np.testing.assert_array_equal(np.asarray(dp.analytics_state.state),
+                                  mirror)
+
+
+# ---------------------------------------------------- disabled path
+
+def test_disabled_path_is_byte_identical():
+    import jax.numpy as jnp
+    base = _engine(analytics=False, flows=False, threat=False)
+    tog = _engine(flows=False, threat=False)
+    stage = jnp.asarray(np.zeros((10, 16), np.int32))
+    en_txt = tog._step_packed.lower(
+        *tog._lower_args_packed(stage)).as_text()
+    tog.disable_analytics()
+    base_txt = base._step_packed.lower(
+        *base._lower_args_packed(stage)).as_text()
+    tog_txt = tog._step_packed.lower(
+        *tog._lower_args_packed(stage)).as_text()
+    assert tog_txt == base_txt
+    assert en_txt != base_txt
+    assert base.dispatch_leaf_counts() == tog.dispatch_leaf_counts()
+
+
+# ---------------------------------------------------- epoch protocol
+
+def test_epoch_swap_quiesced_section_immutable_under_load():
+    """A swap is one control-cell write: host decodes read the
+    quiesced section while serving keeps folding batches into the
+    OTHER section — and the next swap zeroes only the section about
+    to be written."""
+    dp = _engine(flows=False, threat=False, stripe=1)
+    rng = np.random.default_rng(5)
+    stage, _ = _traffic(rng, 64, 40000)
+    dp.process_packed(stage, now=100)
+    q = dp.swap_analytics_epoch()
+    snap = dp.analytics_snapshot()
+    sec_q = adec.epoch_section(snap, q, DEPTH, LANES).copy()
+    assert sec_q.any(), "the drained epoch must hold the traffic"
+    assert adec.write_epoch(snap, DEPTH, LANES) == 1 - q
+    assert dp.analytics_report()["write-epoch"] == 1 - q
+    # serving continues: new batches land only in the write section
+    stage2, _ = _traffic(rng, 64, 50000)
+    dp.process_packed(stage2, now=104)
+    dp.process_packed(stage2, now=105)
+    snap2 = dp.analytics_snapshot()
+    np.testing.assert_array_equal(
+        adec.epoch_section(snap2, q, DEPTH, LANES), sec_q,
+        err_msg="the quiesced section moved under serving load")
+    sec_w = adec.epoch_section(snap2, 1 - q, DEPTH, LANES).copy()
+    assert sec_w.any()
+    # the next swap zeroes the STALE section, quiesces the live one
+    q2 = dp.swap_analytics_epoch()
+    assert q2 == 1 - q
+    snap3 = dp.analytics_snapshot()
+    assert not adec.epoch_section(snap3, q, DEPTH, LANES).any()
+    np.testing.assert_array_equal(
+        adec.epoch_section(snap3, q2, DEPTH, LANES), sec_w)
+
+
+# ------------------------------------------------------ decode views
+
+def _plant(state, identity, dports, sports, saddrs, lengths,
+           dropped=False):
+    n = len(dports)
+    oracle_analytics_step(
+        state, identity=np.full(n, identity, np.int64),
+        dport=np.array(dports, np.int64),
+        proto=np.full(n, 6, np.int64),
+        sport=np.array(sports, np.int64),
+        length=np.array(lengths, np.int64),
+        verdict=np.full(n, VERDICT_DROP if dropped else 0, np.int64),
+        saddr_key=np.array(saddrs, np.int64),
+        daddr_key=np.full(n, (20 << 24) | 9, np.int64),
+        now=0, depth=DEPTH, lanes=LANES, stripe=1)
+
+
+def test_decode_views_name_the_planted_offenders():
+    """Talkers / scanners / spreaders / prefixes over a section with
+    three planted behaviors: a byte-heavy talker, a dport-sweeping
+    scanner (dropped traffic), and a flow-fanning spreader."""
+    state = _blank()
+    # 777: heavy talker — 50 big frames, ONE flow
+    _plant(state, 777, [443] * 50, [40000] * 50,
+           [(10 << 24) | 5] * 50, [1400] * 50)
+    # 999: port scanner — 40 distinct dports, tiny dropped frames
+    _plant(state, 999, list(range(1, 41)), [51000] * 40,
+           [(50 << 24) | 9] * 40, [60] * 40, dropped=True)
+    # 555: spreader — 256 distinct 5-tuples on one service port
+    _plant(state, 555, [53] * 256, list(range(10000, 10256)),
+           list(range(1, 257)), [80] * 256)
+    sec = adec.epoch_section(state, 0, DEPTH, LANES)
+
+    talkers = adec.top_talkers(sec, DEPTH, k=3, metric="bytes")
+    assert talkers[0]["identity"] == 777
+    # count-min is an upper bound: it may over-count, never under
+    assert talkers[0]["count"] >= 50 * 1400
+    drops = adec.top_talkers(sec, DEPTH, k=3, metric="drops")
+    assert drops[0]["identity"] == 999
+    assert drops[0]["count"] >= 40
+
+    scan = adec.top_scanners(sec, DEPTH, k=3, min_dports=16)
+    assert scan[0]["identity"] == 999
+    assert scan[0]["dports"] >= 16 and scan[0]["suspect"]
+    assert all(not e["suspect"] for e in scan if e["identity"] == 777)
+
+    spread = adec.top_spreaders(sec, DEPTH, LANES, k=3)
+    assert spread[0]["identity"] == 555
+    assert spread[0]["flows"] > 0
+
+    prefixes = adec.top_prefixes(sec, DEPTH, k=3, metric="bytes")
+    assert prefixes[0]["prefix"] == ((20 << 24) | 9) >> 8
+    with pytest.raises(KeyError):
+        adec.decode_view(sec, "nonsense", DEPTH, LANES)
+
+
+def test_mesh_merge_adds_sketches_maxes_registers_order_free():
+    a, b = _blank(width=256), _blank(width=256)
+    _plant(a, 777, [443] * 10, [40000] * 10, [(10 << 24) | 5] * 10,
+           [100] * 10)
+    _plant(b, 777, [443] * 5, [45000 + i for i in range(5)],
+           [(10 << 24) | 6] * 5, [100] * 5)
+    sec_a = adec.epoch_section(a, 0, DEPTH, LANES)
+    sec_b = adec.epoch_section(b, 0, DEPTH, LANES)
+    merged = adec.merge_sections([sec_a, sec_b], DEPTH, LANES)
+    n_sketch = N_KEYSPACES * N_METRICS * DEPTH
+    np.testing.assert_array_equal(
+        merged[:n_sketch],
+        sec_a[:n_sketch].astype(np.int64) + sec_b[:n_sketch])
+    np.testing.assert_array_equal(
+        merged[n_sketch:], np.maximum(sec_a[n_sketch:],
+                                      sec_b[n_sketch:]))
+    # shard arrival order is irrelevant
+    np.testing.assert_array_equal(
+        merged, adec.merge_sections([sec_b, sec_a], DEPTH, LANES))
+    # the merged view answers with the mesh-wide count
+    assert adec.cm_query(merged, KS_IDENTITY, MET_BYTES,
+                         np.array([777]), DEPTH)[0] >= 15 * 100
+    t = adec.top_talkers(merged, DEPTH, k=1, metric="bytes")
+    assert t[0]["identity"] == 777 and t[0]["count"] >= 15 * 100
+
+
+# -------------------------------------------- sharded mesh, fail-open
+
+def test_sharded_merge_and_degraded_shard_fails_open():
+    """Each shard folds into its OWN buffer; one mesh-wide query
+    merges them.  A shard whose buffer becomes unreadable degrades
+    the answer to a flagged ``partial`` served from the remaining
+    shards — fail-open, breaker opens after repeated failures, and
+    the healthy shard keeps serving throughout."""
+    from cilium_tpu.parallel.sharded import ShardedDatapath
+    p = ShardedDatapath(n_shards=2, ct_slots=1 << 8)
+    p.telemetry_enabled = False
+    p.enable_analytics(width=1 << 8, depth=DEPTH, lanes=LANES,
+                       stripe=1)
+    p.load_policy([_policy() for _ in range(4)], revision=1,
+                  ipcache_prefixes={"10.0.0.0/8": HTTP_ID,
+                                    "20.0.0.0/8": DNS_ID})
+    n = 32
+
+    def _recs(endpoint, direction, sport0):
+        return {
+            "endpoint": np.full(n, endpoint, np.int32),
+            "saddr": np.full(n, (10 << 24) | 5, np.uint32)
+            .view(np.int32),
+            "daddr": np.full(n, (20 << 24) | 9, np.uint32)
+            .view(np.int32),
+            "sport": (sport0 + np.arange(n)).astype(np.int32),
+            "dport": np.full(n, 80 if direction == 0 else 53,
+                             np.int32),
+            "proto": np.full(n, 6 if direction == 0 else 17, np.int32),
+            "direction": np.full(n, direction, np.int32),
+            "tcp_flags": np.full(n, 0x02, np.int32),
+            "length": np.full(n, 100, np.int32),
+            "is_fragment": np.zeros(n, np.int32),
+        }
+
+    try:
+        # shard 0 sees identity 777 (ingress), shard 1 identity 888
+        # (egress) — shard-local buffers, mesh-wide answer
+        p.classify_records(_recs(0, 0, 56000), n)
+        p.classify_records(_recs(1, 1, 57000), n)
+        assert np.asarray(p.shards[0].analytics_state.state).any()
+        assert np.asarray(p.shards[1].analytics_state.state).any()
+        out = p.analytics_query(view="talkers", k=10, metric="bytes",
+                                swap=True)
+        assert out["partial"] is False
+        assert all(s["status"] == "ok"
+                   for s in out["shards"].values())
+        ids = {e["identity"] for e in out["entries"]}
+        assert {HTTP_ID, DNS_ID} <= ids, \
+            "the merged view must cover BOTH shards' traffic"
+        # shard 1's device buffer goes unreadable: the next query is
+        # a flagged partial served from shard 0 alone (swap-free —
+        # the quiesced sections still hold the drained epoch)
+        p.shards[1].analytics_state = None
+        out2 = p.analytics_query(view="talkers", k=10,
+                                 metric="bytes", swap=False)
+        assert out2["partial"] is True
+        assert out2["shards"]["1"]["status"] == "error"
+        assert out2["shards"]["0"]["status"] == "ok"
+        ids2 = {e["identity"] for e in out2["entries"]}
+        assert HTTP_ID in ids2 and DNS_ID not in ids2
+        # a second failure trips the shard's breaker; the mesh answer
+        # stays partial without even touching the dead shard
+        p.analytics_sections(swap=False)
+        out3 = p.analytics_sections(swap=False)
+        assert out3["shards"]["1"]["status"] == "breaker-open"
+        assert out3["partial"] is True
+        assert p.analytics_report()["open-breakers"] == 1
+        # the healthy shard never paused: serving still answers
+        v, _i = p.classify_records(_recs(0, 0, 58000), n)
+        assert v.shape[0] == n
+    finally:
+        p.serving().close()
+
+
+# ------------------------------------------------ live-daemon journey
+
+def test_live_daemon_analytics_journey(capsys):
+    """traffic -> drain -> gauges/events -> REST -> CLI: the full
+    operator loop on a live agent with analytics enabled.  Heavy-
+    hitter and scan-suspect transitions are edge-triggered (a
+    sustained hitter is ONE event), and the top-K byte gauge is
+    cardinality-capped (evicted identities zero out)."""
+    from cilium_tpu.cli import Client
+    from cilium_tpu.cli import main as cli_main
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.daemon.rest import APIServer
+    from cilium_tpu.observability.events import (
+        EVENT_TRAFFIC_HEAVY_HITTER, EVENT_TRAFFIC_SCAN_SUSPECT,
+        recorder)
+    from cilium_tpu.utils.metrics import (ANALYTICS_SCAN_SUSPECTS,
+                                          ANALYTICS_TOP_BYTES)
+    from cilium_tpu.utils.option import DaemonConfig
+
+    def _count(ev_type):
+        return sum(1 for ev in recorder.events(limit=0)
+                   if ev.type == ev_type)
+
+    d = Daemon(config=DaemonConfig(
+        state_dir="", drift_audit_interval_s=0,
+        ct_checkpoint_interval_s=0, enable_analytics=True,
+        analytics_width=1 << 10, analytics_stripe=1,
+        analytics_drain_interval_s=0,   # manual drains: no racing
+        analytics_top_k=4, analytics_scan_ports=16,
+        analytics_hh_share=0.25))
+    server = APIServer(d).start()
+    base = f"http://127.0.0.1:{server.port}"
+    hh_before = _count(EVENT_TRAFFIC_HEAVY_HITTER)
+    scan_before = _count(EVENT_TRAFFIC_SCAN_SUSPECT)
+    try:
+        st = d.status()["analytics"]
+        assert st["enabled"] and st["status"] == "ok"
+        assert st["report"]["stripe"] == 1
+        d.datapath.load_policy([_policy()], revision=1,
+                               ipcache_prefixes={
+                                   "10.0.0.0/8": HTTP_ID,
+                                   "20.0.0.0/8": DNS_ID})
+        d.datapath.set_endpoint_identity(0, EP_IDENTITY)
+
+        def _drive(now):
+            # identity 777: 64 big allowed HTTP frames (the hitter);
+            # identity 888: a 40-dport egress sweep, denied (the scan)
+            nh, ns = 64, 40
+            hh = {
+                "endpoint": np.zeros(nh, np.int32),
+                "saddr": np.full(nh, (10 << 24) | 5, np.uint32)
+                .view(np.int32),
+                "daddr": np.full(nh, (10 << 24) | 8, np.uint32)
+                .view(np.int32),
+                "sport": (40000 + np.arange(nh)).astype(np.int32),
+                "dport": np.full(nh, 80, np.int32),
+                "proto": np.full(nh, 6, np.int32),
+                "direction": np.zeros(nh, np.int32),
+                "tcp_flags": np.full(nh, 0x02, np.int32),
+                "length": np.full(nh, 1400, np.int32),
+                "is_fragment": np.zeros(nh, np.int32),
+            }
+            sc = {
+                "endpoint": np.zeros(ns, np.int32),
+                "saddr": np.full(ns, (10 << 24) | 5, np.uint32)
+                .view(np.int32),
+                "daddr": np.full(ns, (20 << 24) | 9, np.uint32)
+                .view(np.int32),
+                "sport": np.full(ns, 51000, np.int32),
+                "dport": (1 + np.arange(ns)).astype(np.int32),
+                "proto": np.full(ns, 6, np.int32),
+                "direction": np.ones(ns, np.int32),
+                "tcp_flags": np.full(ns, 0x02, np.int32),
+                "length": np.full(ns, 60, np.int32),
+                "is_fragment": np.zeros(ns, np.int32),
+            }
+            d.datapath.process_packed(_stage_of(hh), now=now)
+            d.datapath.process_packed(_stage_of(sc), now=now + 1)
+
+        _drive(100)
+        out = d.analytics_drain()
+        assert out["status"] == "ok"
+        assert out["top"][0]["identity"] == HTTP_ID
+        assert DNS_ID in out["suspects"]
+        assert ANALYTICS_TOP_BYTES.value(
+            labels={"identity": str(HTTP_ID)}) == \
+            out["top"][0]["count"]
+        assert ANALYTICS_SCAN_SUSPECTS.value() >= 1
+        assert _count(EVENT_TRAFFIC_HEAVY_HITTER) == hh_before + 1
+        assert _count(EVENT_TRAFFIC_SCAN_SUSPECT) == scan_before + 1
+        st = d.status()["analytics"]
+        assert HTTP_ID in st["heavy-hitters"]
+        assert DNS_ID in st["scan-suspects"]
+
+        # sustained anomaly: the SAME offenders drain again — no
+        # duplicate flight-recorder events (edge-triggered)
+        _drive(200)
+        out2 = d.analytics_drain()
+        assert out2["top"][0]["identity"] == HTTP_ID
+        assert _count(EVENT_TRAFFIC_HEAVY_HITTER) == hh_before + 1
+        assert _count(EVENT_TRAFFIC_SCAN_SUSPECT) == scan_before + 1
+
+        # REST reads the QUIESCED epoch swap-free
+        c = Client(base)
+        assert c.get("/analytics")["enabled"] is True
+        got = c.get("/analytics/top?view=talkers&n=5&metric=bytes")
+        assert got["partial"] is False
+        assert got["entries"][0]["identity"] == HTTP_ID
+        got2 = c.get("/analytics/top?view=scanners&n=5")
+        assert any(e["identity"] == DNS_ID and e["suspect"]
+                   for e in got2["entries"])
+
+        # the CLI twin renders the same answers
+        assert cli_main(["--api", base, "top", "talkers",
+                         "-n", "5"]) == 0
+        assert str(HTTP_ID) in capsys.readouterr().out
+        assert cli_main(["--api", base, "top", "scanners"]) == 0
+        cli_out = capsys.readouterr().out
+        assert str(DNS_ID) in cli_out and "SCAN-SUSPECT" in cli_out
+
+        # a quiet epoch: the capped gauge export zeroes the evicted
+        # identities so the label set never grows under churn
+        out3 = d.analytics_drain()
+        assert out3["top"] == []
+        assert ANALYTICS_TOP_BYTES.value(
+            labels={"identity": str(HTTP_ID)}) == 0
+        assert ANALYTICS_SCAN_SUSPECTS.value() == 0
+    finally:
+        server.shutdown()
+        d.shutdown()
+
+
+# ----------------------------------------------------- status shapes
+
+def test_engine_report_and_disabled_status_shapes():
+    dp = _engine(flows=False, threat=False, provenance=False)
+    rep = dp.analytics_report()
+    assert rep == {"width": WIDTH, "depth": DEPTH, "lanes": LANES,
+                   "stripe": STRIPE, "shard": dp.shard_index,
+                   "write-epoch": 0}
+    dp.disable_analytics()
+    assert dp.analytics_report() is None
+    assert dp.analytics_snapshot() is None
+    with pytest.raises(RuntimeError):
+        dp.swap_analytics_epoch()

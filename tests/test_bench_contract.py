@@ -58,7 +58,8 @@ def test_smoke_final_line_parses_and_fits(tmp_path):
     for name in ("identity-l4", "http-regex", "kafka-acl", "fqdn",
                  "l7-fast", "capacity", "incremental", "latency-tier",
                  "dispatch-floor", "overload", "mesh-shard",
-                 "threat-score", "control-churn"):
+                 "threat-score", "analytics-overhead",
+                 "control-churn"):
         assert name in suite, f"{name} missing from compact suite"
         assert "value" in suite[name]
         assert "vs_baseline" in suite[name]
@@ -135,6 +136,27 @@ def test_smoke_writes_full_result_file(tmp_path):
     for key in ("push_ms", "hot_swap_applied", "zero_repacks",
                 "generation", "no_serving_pause"):
         assert key in hs, key
+    # the analytics-overhead schema is pinned: fused sketch-plane
+    # overhead vs the pre-analytics program (gated <= 10%), the
+    # mid-serving epoch swap, the attack-shape decode leg, and the
+    # disabled-path byte-identity gate
+    an = res["extra"]["suite_configs"]["analytics-overhead"]
+    assert an["unit"] == "verdicts/s"
+    for key in ("baseline_vps", "analytics_vps", "overhead_pct",
+                "gate_overhead_le_10pct", "geometry", "attack",
+                "analytics_disabled_byte_identical"):
+        assert key in an["extra"], key
+    for key in ("width", "depth", "lanes", "stripe"):
+        assert key in an["extra"]["geometry"], key
+    sw = an["extra"]["epoch_swap"]
+    for key in ("swap_ms", "pre_swap_batch_ms", "post_swap_batch_ms",
+                "no_serving_pause"):
+        assert key in sw, key
+    atk = an["extra"]["attack"]
+    for key in ("attacker_identity", "top_talker_identity",
+                "gate_top_talker_named_attacker", "scan_suspects",
+                "gate_scan_view_fired"):
+        assert key in atk, key
     # the overload schema is pinned: per-multiplier legs with accepted
     # percentiles + shed accounting, admission vs unbounded
     ovl = res["extra"]["suite_configs"]["overload"]
@@ -295,6 +317,39 @@ def test_committed_threat_score_artifact_is_real():
     assert ex["hot_swap"]["no_serving_pause"] is True
     assert ex["threat_disabled_byte_identical"] is True
     assert ex["enforce"]["dropped"] + ex["enforce"]["rate_limited"] > 0
+
+
+def test_committed_analytics_overhead_artifact_is_real():
+    """The committed CPU artifact must prove the analytics tentpole's
+    claims: the fused sketch/cardinality stage within the <=10%
+    overhead gate on the 1000-rule config, the decoded top-K naming
+    the attack leg's attacker identity with the scan view fired, and
+    the analytics-disabled pipeline byte-identical (lowered HLO)."""
+    import glob
+    found = []
+    for f in sorted(glob.glob(os.path.join(REPO, "BENCH_FULL_*.json"))):
+        try:
+            doc = json.load(open(f))
+        except (OSError, ValueError):
+            continue
+        cfg = doc.get("result", {}).get("extra", {}) \
+            .get("suite_configs", {}).get("analytics-overhead")
+        if isinstance(cfg, dict) and not cfg.get("extra",
+                                                 {}).get("smoke"):
+            found.append(cfg)
+    assert found, \
+        "no committed BENCH_FULL_*.json carries a real " \
+        "analytics-overhead config"
+    ex = found[-1]["extra"]
+    assert ex["gate_overhead_le_10pct"] is True
+    assert ex["overhead_pct"] <= 10.0
+    assert ex["epoch_swap"]["no_serving_pause"] is True
+    atk = ex["attack"]
+    assert atk["gate_top_talker_named_attacker"] is True
+    assert atk["top_talker_identity"] == atk["attacker_identity"]
+    assert atk["gate_scan_view_fired"] is True
+    assert atk["attacker_identity"] in atk["scan_suspects"]
+    assert ex["analytics_disabled_byte_identical"] is True
 
 
 def test_committed_multichip_artifact_is_real():

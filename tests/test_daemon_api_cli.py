@@ -943,6 +943,49 @@ def test_flight_recorder_rest_and_cli_events(agent, capsys):
     os.unlink(path)
 
 
+def test_follow_mode_never_busy_spins(agent, monkeypatch, capsys):
+    """Satellite fix: the follow loops (`monitor -f`, `hubble observe
+    -f`, `events -f`) used to sleep 0 whenever the last poll returned
+    events — a steadily-busy emitter turned the follower into a
+    CPU-pinned hot loop against the agent API.  The pacing helper
+    floors the inter-poll sleep at a fraction of --interval."""
+    from cilium_tpu import cli as cli_mod
+    from cilium_tpu.observability.events import (EVENT_SERVING_OVERLOAD,
+                                                 recorder)
+    d, srv = agent
+
+    # the helper's contract: drained polls wait the full interval,
+    # busy polls are floored, never zero — even for interval 0
+    slept = []
+    monkeypatch.setattr(cli_mod.time, "sleep",
+                        lambda s: slept.append(s))
+    cli_mod._follow_sleep(1.0, drained=True)
+    cli_mod._follow_sleep(1.0, drained=False)
+    cli_mod._follow_sleep(0.0, drained=False)
+    assert slept == [1.0, pytest.approx(0.05), 0.02]
+
+    # end to end: events -f with a fresh event landing during EVERY
+    # sleep, so every poll comes back busy — each inter-poll sleep
+    # still runs with a positive floor
+    base = recorder.last_seq
+    recorder.record(EVENT_SERVING_OVERLOAD, state="on", pending=1)
+    calls = []
+
+    def busy_sleep(s):
+        calls.append(s)
+        recorder.record(EVENT_SERVING_OVERLOAD, state="on",
+                        pending=len(calls))
+        if len(calls) >= 4:
+            raise KeyboardInterrupt
+
+    monkeypatch.setattr(cli_mod.time, "sleep", busy_sleep)
+    assert cli_main(["--api", srv.base_url, "events", "-f",
+                     "--since", str(base), "--interval", "1.0"]) == 0
+    capsys.readouterr()
+    assert len(calls) == 4
+    assert all(0 < s < 1.0 for s in calls)
+
+
 def test_flows_shard_param_requires_sharded_dataplane(agent):
     """/flows?shard=K is a sharded-daemon surface: the single-engine
     daemon answers 400, not a silent empty list."""

@@ -41,6 +41,38 @@ class TestFlightRecorder:
         # the surviving events are the NEWEST, cursors intact
         assert [e.seq for e in fr.events(limit=0)] == [7, 8, 9, 10]
 
+    def test_eviction_accounting_split_by_evicted_type(self):
+        """The dropped accounting names WHICH type overran the ring: a
+        noisy emitter flooding the recorder shows up as its own type's
+        eviction count, not an anonymous aggregate a quieter type
+        could hide behind."""
+        fr = FlightRecorder(capacity=4)
+        for _ in range(6):
+            fr.record("serving-overload", state="on")
+        for _ in range(2):
+            fr.record("map-pressure-warning", map="ct", shard=None)
+        # 8 recorded, 4 survive; the 4 evicted are the oldest — all
+        # the noisy emitter's
+        st = fr.stats()
+        assert fr.evicted == 4
+        assert st["evicted-by-type"] == {"serving-overload": 4}
+        # push the quieter type out too: both types now accounted
+        for _ in range(4):
+            fr.record("serving-overload", state="on")
+        by_type = fr.stats()["evicted-by-type"]
+        assert by_type["map-pressure-warning"] == 2
+        assert sum(by_type.values()) == fr.evicted
+
+    def test_eviction_counter_labeled_by_type(self):
+        ctr = metrics_mod.registry._metrics[
+            "cilium_tpu_flight_recorder_dropped_total"]
+        before = ctr.value(labels={"type": "serving-overload"})
+        fr = FlightRecorder(capacity=2)
+        for _ in range(5):
+            fr.record("serving-overload", state="on")
+        assert ctr.value(
+            labels={"type": "serving-overload"}) == before + 3
+
     def test_undeclared_type_raises(self):
         fr = FlightRecorder()
         with pytest.raises(ValueError):

@@ -230,6 +230,73 @@ class TestHistogramZeroObservations:
         assert h.count() == 1 and h.sum_value() == pytest.approx(0.05)
 
 
+class TestObserveManyEdgeCases:
+    """The batched-ingest path (observe_many) at its boundaries: a
+    zero-count call, negative observation values, numpy-integer
+    counts, and the monitor's per-score path fed an empty batch —
+    each must keep the exposition Prometheus-conformant."""
+
+    def test_count_zero_is_a_noop_on_every_series(self):
+        h = Histogram("cilium_tpu_test_many_zero", "zc",
+                      buckets=(0.1, 1.0))
+        h.observe_many(0.5, 0)
+        assert h.count() == 0
+        assert h.sum_value() == 0.0
+        lines = h.expose()
+        assert "cilium_tpu_test_many_zero_count 0" in lines
+        assert "cilium_tpu_test_many_zero_sum 0.0" in lines
+        assert 'cilium_tpu_test_many_zero_bucket{le="+Inf"} 0' \
+            in lines
+        # still the full declared series, nothing duplicated
+        assert len(lines) == 2 + 3
+
+    def test_negative_values_bucket_cumulatively(self):
+        h = Histogram("cilium_tpu_test_many_neg", "neg",
+                      buckets=(0.1, 1.0))
+        h.observe_many(-2.0, 3)
+        # a negative observation lands in EVERY bucket (cumulative
+        # le-semantics) and drives _sum negative — never a lost count
+        assert h.count() == 3
+        assert h.sum_value() == pytest.approx(-6.0)
+        lines = h.expose()
+        assert 'cilium_tpu_test_many_neg_bucket{le="0.1"} 3' in lines
+        assert 'cilium_tpu_test_many_neg_bucket{le="+Inf"} 3' in lines
+        # bucket counts stay monotonically non-decreasing in le order
+        counts = [int(l.rsplit(" ", 1)[1]) for l in lines
+                  if "_bucket" in l]
+        assert counts == sorted(counts)
+
+    def test_numpy_integer_counts_coerce(self):
+        import numpy as np
+        h = Histogram("cilium_tpu_test_many_np", "np",
+                      buckets=(0.1, 1.0))
+        h.observe_many(0.05, np.int64(4))
+        h.observe_many(0.5, np.int32(2))
+        assert h.count() == 6
+        assert isinstance(h.count(), int)
+        assert h.sum_value() == pytest.approx(0.05 * 4 + 0.5 * 2)
+
+    def test_monitor_per_score_path_with_empty_batch(self):
+        import numpy as np
+        from cilium_tpu.monitor import MonitorHub
+        from cilium_tpu.utils.metrics import (THREAT_SCORES,
+                                              THREAT_VERDICTS)
+        hub = MonitorHub()
+        empty = np.zeros(0, dtype=np.int32)
+        scores_before = THREAT_SCORES.total_count()
+        verdicts_before = THREAT_VERDICTS.total()
+        # an empty batch with the threat lane attached must be a
+        # clean no-op: no samples, no counters, no exceptions
+        hub.ingest_batch(empty, empty, empty, empty, empty, empty,
+                         tiers=empty, match_slots=empty,
+                         threat_out=empty)
+        assert THREAT_SCORES.total_count() == scores_before
+        assert THREAT_VERDICTS.total() == verdicts_before
+        assert hub.tail(10) == []
+        assert hub.lost == 0
+        assert hub.top_dropped_rules() == []
+
+
 # ------------------------------------------------- registry-wide conformance
 
 def _parse_metrics(text):

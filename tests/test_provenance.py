@@ -366,6 +366,57 @@ class TestProvenancePropagation:
         top = hub.top_dropped_rules()
         assert {"rule": rule, "packets": 1} in top
 
+    def test_rule_key_cardinality_cap_under_synthetic_scan(self):
+        """The label-cardinality guard: thousands of distinct denied
+        keys in ONE batch (a port scan's signature) admit at most
+        MAX_RULE_KEYS_PER_BATCH keys into the per-rule counter —
+        biggest offenders first — while the aggregate drop counter
+        still counts every packet."""
+        from cilium_tpu.datapath.events import DROP_NAMES, DROP_POLICY
+        from cilium_tpu.monitor import (MAX_RULE_KEYS_PER_BATCH,
+                                        MonitorHub)
+        from cilium_tpu.utils.metrics import (DROP_COUNT,
+                                              POLICY_RULE_DROPS)
+        hub = MonitorHub()
+        # one loud offender (64 packets on one key) over a scan of
+        # 3000 single-packet keys, all denied in the same batch
+        n_scan = 3000
+        dports = np.concatenate([np.full(64, 9999),
+                                 1 + np.arange(n_scan)])
+        b = dports.shape[0]
+        drops_before = DROP_COUNT.value(
+            labels={"reason": DROP_NAMES[DROP_POLICY]})
+        rules_before = POLICY_RULE_DROPS.total()
+        hub.ingest_batch(np.full(b, DROP_POLICY), np.zeros(b),
+                         np.full(b, 777), dports, np.full(b, 6),
+                         np.full(b, 100), tiers=np.full(b, TIER_DENY),
+                         match_slots=np.full(b, -1))
+        # the cap holds: exactly MAX_RULE_KEYS_PER_BATCH distinct keys
+        # admitted, the 64-packet offender among them
+        top = hub.top_dropped_rules(n=10 * MAX_RULE_KEYS_PER_BATCH)
+        assert len(top) == MAX_RULE_KEYS_PER_BATCH
+        assert top[0] == {"rule": format_denied_key(777, 9999, 6),
+                          "packets": 64}
+        assert all(t["packets"] == 1 for t in top[1:])
+        # per-rule series: only the admitted keys advanced it
+        assert POLICY_RULE_DROPS.total() - rules_before == \
+            64 + (MAX_RULE_KEYS_PER_BATCH - 1)
+        # aggregate accounting stays accurate: EVERY packet counted
+        assert DROP_COUNT.value(
+            labels={"reason": DROP_NAMES[DROP_POLICY]}) - \
+            drops_before == b
+        # a second scan batch admits its own top keys; cumulative
+        # top-dropped stays sorted with the offender on top
+        hub.ingest_batch(np.full(8, DROP_POLICY), np.zeros(8),
+                         np.full(8, 778), np.full(8, 53),
+                         np.full(8, 17), np.full(8, 60),
+                         tiers=np.full(8, TIER_DENY),
+                         match_slots=np.full(8, -1))
+        top2 = hub.top_dropped_rules(n=2)
+        assert top2[0]["packets"] == 64
+        assert top2[1] == {"rule": format_denied_key(778, 53, 17),
+                          "packets": 8}
+
     def test_flow_records_carry_tier(self):
         from cilium_tpu.hubble.filter import FlowFilter
         from cilium_tpu.hubble.observer import FlowObserver

@@ -108,10 +108,15 @@ PACKED_STEP_WITH_L7_CEILING = PACKED_STEP_LEAF_CEILING + 2
 # threat-model group (quantized weights + config as ONE buffer) and
 # the [6, T+1] shard-local ThreatState token-bucket/window buffer
 PACKED_STEP_WITH_THREAT_CEILING = PACKED_STEP_LEAF_CEILING + 2
+# traffic analytics adds exactly ONE leaf: the [R, W] shard-local
+# A/B-epoch sketch buffer (sketches + candidate key tables +
+# cardinality registers + control cell packed into a single int32
+# array precisely so the dispatch floor pays one leaf, not four)
+PACKED_STEP_WITH_ANALYTICS_CEILING = PACKED_STEP_LEAF_CEILING + 1
 
 
 def _loaded_engine(flows: bool = False, l7_fast: bool = False,
-                   threat: bool = False):
+                   threat: bool = False, analytics: bool = False):
     from bench import build_config1
     from cilium_tpu.datapath.engine import Datapath
     states, prefixes = build_config1(n_rules=10, n_endpoints=4)
@@ -130,6 +135,8 @@ def _loaded_engine(flows: bool = False, l7_fast: bool = False,
     if threat:
         from cilium_tpu.threat import default_model
         dp.enable_threat(default_model(), buckets=1 << 8)
+    if analytics:
+        dp.enable_analytics(width=1 << 8)
     dp.load_policy(states, revision=1, ipcache_prefixes=prefixes)
     return dp
 
@@ -195,6 +202,26 @@ def test_jitted_step_leaf_ceiling_with_threat():
         specs.SHARD_LOCAL
 
 
+def test_jitted_step_leaf_ceiling_with_analytics():
+    """The analytics step: the ONE [R, W] AnalyticsState buffer is
+    the only new leaf (sketches, key tables, cardinality registers
+    and the epoch control cell all pack into it), and it carries a
+    declared shard-local spec like CT/flow/threat state."""
+    from cilium_tpu.parallel import packing
+    dp = _loaded_engine(analytics=True)
+    counts = dp.dispatch_leaf_counts()
+    assert counts["packed-step"] <= \
+        PACKED_STEP_WITH_ANALYTICS_CEILING, counts
+    plain = _loaded_engine()
+    assert plain.dispatch_leaf_counts()["packed-step"] <= \
+        PACKED_STEP_LEAF_CEILING
+    # the sketch leaf is registered shard-local, like CT
+    assert specs.ANALYTICS_STATE_SPECS["state"] == specs.SHARD_LOCAL
+    assert "AnalyticsState" in specs.registry()
+    assert specs.PACKED_GROUP_SPECS[packing.ANALYTICS_STATE_GROUP] \
+        == specs.SHARD_LOCAL
+
+
 def test_every_packed_group_has_a_declared_spec():
     from cilium_tpu.parallel import packing
     dp = _loaded_engine(l7_fast=True)
@@ -203,7 +230,8 @@ def test_every_packed_group_has_a_declared_spec():
               | set(dp._manifest6.group_names())
               | set(thr._manifest4.group_names())
               | {packing.CT_STATE_GROUP, packing.COUNTERS_GROUP,
-                 packing.FLOW_STATE_GROUP, packing.THREAT_STATE_GROUP})
+                 packing.FLOW_STATE_GROUP, packing.THREAT_STATE_GROUP,
+                 packing.ANALYTICS_STATE_GROUP})
     undeclared = groups - set(specs.PACKED_GROUP_SPECS)
     assert not undeclared, (
         "packed dispatch-buffer groups without a declared "

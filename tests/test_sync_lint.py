@@ -53,6 +53,13 @@ HOT_MODULES = (
     "cilium_tpu/threat/model.py",
     "cilium_tpu/threat/oracle.py",
     "cilium_tpu/threat/trainer.py",
+    # the device traffic-analytics plane: the fused sketch stage runs
+    # inside the jitted steps, the oracle is host-side parity code,
+    # the decoder reads only quiesced host snapshots — zero sync
+    # markers by construction in all three
+    "cilium_tpu/analytics/stage.py",
+    "cilium_tpu/analytics/oracle.py",
+    "cilium_tpu/analytics/decode.py",
 )
 
 # the engine is hot only in its dispatch functions — table loading,
